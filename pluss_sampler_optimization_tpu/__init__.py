@@ -1,0 +1,54 @@
+"""PLUSS-TPU: a TPU-native parallel-locality static-sampling framework.
+
+A ground-up re-design of PLUSS (Parallel Locality analysis Using Static
+Sampling; reference implementation: sauceeeeage/PLUSS_Sampler_Optimization)
+for TPU hardware via JAX/XLA.
+
+The reference simulates the interleaved execution of THREAD_NUM OpenMP
+threads over a parallel loop nest, measures reuse intervals (RI) per
+simulated thread, applies a concurrent-reuse-interval (CRI) probability
+model, and integrates the result into an LRU miss-ratio curve (MRC).
+Its execution engine is a serial (or modestly threaded) C++/Rust state
+machine walk over the interleaved iteration space
+(reference: c_lib/test/sampler/*.cpp, src/gemm_sampler*.rs).
+
+This framework keeps the *model semantics* bit-exact but replaces the
+execution engine with array programs:
+
+- the per-simulated-thread access stream is a closed-form indexed
+  sequence (core/trace.py), not a stateful walk;
+- full-traversal RI measurement is a lexsort + segmented diff
+  (sampler/dense.py), jit-compiled and vmapped over simulated threads;
+- random-start sampling (the reference's `rs-ri-opt-r10` variant,
+  c_lib/test/sampler/gemm-t4-pluss-pro-model-rs-ri-opt-r10.cpp) becomes a
+  vmapped O(1)-per-sample closed-form next-use solver (sampler/sampled.py)
+  instead of an amortized serial fast-forward walk;
+- histogram reductions use dense pow2-binned vectors with
+  `jax.lax.psum` across a device mesh (parallel/), replacing the
+  reference's mutex / thread-local-merge reductions
+  (src/unsafe_utils.rs:105-151, pluss_utils.cpp:4-14);
+- the CRI model (negative-binomial spread + racetrack pow2 split,
+  pluss_utils.h:987-1208) and AET->MRC integration (pluss_utils.h:758-804)
+  run on host, consuming device-side histograms.
+
+64-bit integers are required: per-thread trace positions exceed 2^31 for
+N >= 2048 (a tid's trace has (N/T)*N*(4N+2) accesses for GEMM).
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .config import MachineConfig, SamplerConfig  # noqa: E402
+from .ir import Loop, Ref, ParallelNest, Program  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MachineConfig",
+    "SamplerConfig",
+    "Loop",
+    "Ref",
+    "ParallelNest",
+    "Program",
+]

@@ -1,0 +1,110 @@
+"""Closed-form OpenMP static-chunk scheduling arithmetic.
+
+Replaces the reference's stateful `ChunkDispatcher`
+(c_lib/test/runtime/pluss_utils.h:287-618, src/chunk_dispatcher.rs) with
+index math. The dispatcher hands chunk `c` (CHUNK_SIZE consecutive
+parallel-loop iterations) to simulated thread c % THREAD_NUM
+(getNextStaticChunk, pluss_utils.h:410-425; per-thread start points
+advance by chunk_size*THREAD_NUM*step, :420). The derived per-iteration
+quantities below are the closed forms the reference itself documents:
+
+  tid(i) = ((i-start)/step)/chunk_size mod THREAD_NUM
+                                   (getStaticTid, pluss_utils.h:429-431)
+  cid(i) = floor(((i-start)/step) / (chunk_size*THREAD_NUM))
+                                   (getStaticChunkID, :433-435)
+  pos(i) = ((i-start)/step) mod chunk_size
+                                   (getStaticThreadLocalPos, :437-439)
+
+plus the inverse map (thread-local index -> iteration value) that the
+array engines need and the reference never materializes. Every function
+is plain integer arithmetic and works elementwise on Python ints, numpy
+arrays and traced jax arrays alike.
+
+Only the static schedule is implemented: the reference's dynamic-chunk
+surface is dead code in every live sampler (the Rust port leaves it
+`unimplemented!`, src/chunk_dispatcher.rs:34-69).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticSchedule:
+    """Static chunk schedule of one parallel loop.
+
+    `trip`, `start`, `step` describe the parallel loop (level 0);
+    `chunk` is CHUNK_SIZE and `threads` is THREAD_NUM.
+    Normalized index n = (i - start) // step ranges over [0, trip).
+    """
+
+    trip: int
+    chunk: int
+    threads: int
+    start: int = 0
+    step: int = 1
+
+    # -- global-iteration queries (forward maps) ---------------------------
+
+    def normalize(self, value):
+        """Iteration value -> normalized index n."""
+        return (value - self.start) // self.step
+
+    def value(self, n):
+        """Normalized index -> iteration value."""
+        return self.start + n * self.step
+
+    def owner_tid(self, n):
+        """Simulated thread that executes normalized iteration n."""
+        return (n // self.chunk) % self.threads
+
+    def local_chunk_id(self, n):
+        """Thread-local chunk id (cid) of normalized iteration n."""
+        return n // (self.chunk * self.threads)
+
+    def chunk_pos(self, n):
+        """Position within its chunk (pos) of normalized iteration n."""
+        return n % self.chunk
+
+    def local_index(self, n):
+        """Index of n within its owner thread's own iteration sequence.
+
+        Only the globally-last chunk can be short, so every preceding
+        chunk of the owner contributes exactly `chunk` iterations.
+        """
+        return self.local_chunk_id(n) * self.chunk + self.chunk_pos(n)
+
+    # -- per-thread queries (inverse maps) ----------------------------------
+
+    @property
+    def n_chunks(self) -> int:
+        return -(-self.trip // self.chunk)
+
+    @property
+    def last_chunk_len(self) -> int:
+        rem = self.trip % self.chunk
+        return rem if rem else self.chunk
+
+    def local_count(self, tid: int) -> int:
+        """Number of parallel-loop iterations simulated thread `tid` runs."""
+        nch = self.n_chunks
+        if tid >= nch:
+            return 0
+        mine = (nch - 1 - tid) // self.threads + 1
+        total = mine * self.chunk
+        if (nch - 1) % self.threads == tid:
+            total += self.last_chunk_len - self.chunk
+        return total
+
+    def max_local_count(self) -> int:
+        return max(self.local_count(t) for t in range(self.threads))
+
+    def local_to_normalized(self, tid, m):
+        """Thread-local index m of thread tid -> normalized iteration n."""
+        cid = m // self.chunk
+        pos = m % self.chunk
+        return (cid * self.threads + tid) * self.chunk + pos
+
+    def local_to_value(self, tid, m):
+        return self.value(self.local_to_normalized(tid, m))

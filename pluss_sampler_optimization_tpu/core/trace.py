@@ -1,0 +1,220 @@
+"""Per-simulated-thread trace codec: the state machine as index math.
+
+The reference walks the interleaved iteration space with a per-thread
+state machine (`Progress` cursor + while(true) dispatch,
+...ri-omp-seq.cpp:68-301) and counts accesses in `count[tid]`
+(:45, incremented once per access). Two facts make that walk a
+closed-form indexed sequence:
+
+1. `count[tid]` IS the thread-local trace position: every access of
+   simulated thread t increments only count[t], so the "time" recorded
+   in the last-access tables (LAT_X[tid][addr] = count[tid], :119) is
+   the position of that access in t's own stream, and a reuse interval
+   (:110) is a difference of positions in that stream.
+2. The stream itself is a mixed-radix enumeration of the loop nest:
+   thread t executes its chunks in dispatch order
+   (getNextStaticChunk, pluss_utils.h:410-425), and each parallel-loop
+   iteration performs the same statically-known body access sequence
+   (the ri-opt variant already straight-lines it,
+   ...ri-opt.cpp:101-263).
+
+So position(t, m, n1, n2, ref) =
+    m * acc[0] + npre[0] + n1 * acc[1] + npre[1] + n2 * acc[2] + off(ref)
+(terms beyond the ref's level dropped), where m is the thread-local
+index of the parallel iteration, n_l the normalized inner indices,
+acc[l] the per-level body access counts and off the ref's offset within
+its level's body. Interleaving across simulated threads never enters RI
+values — it only exists in the CRI probability model, exactly as in the
+reference.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..config import MachineConfig
+from ..ir import NestTables, Program, nest_tables
+from .schedule import StaticSchedule
+
+
+class NestTrace:
+    """Static trace geometry of one parallel nest."""
+
+    def __init__(self, program: Program, nest_index: int, machine: MachineConfig):
+        self.machine = machine
+        self.nest = program.nests[nest_index]
+        self.tables: NestTables = nest_tables(
+            program, nest_index, machine.thread_num - 1
+        )
+        lp0 = self.nest.loops[0]
+        self.schedule = StaticSchedule(
+            trip=lp0.trip,
+            chunk=machine.chunk_size,
+            threads=machine.thread_num,
+            start=lp0.start,
+            step=lp0.step,
+        )
+        self.npre = tuple(
+            len(self.nest.refs_at(l, "pre")) for l in range(self.nest.depth)
+        )
+
+    @property
+    def acc(self) -> np.ndarray:
+        return self.tables.acc_per_level
+
+    def tid_length(self, tid: int) -> int:
+        """Total accesses simulated thread `tid` performs in this nest."""
+        return self.schedule.local_count(tid) * int(self.acc[0])
+
+    def access_position(self, ref_idx: int, m, n1=0, n2=0):
+        """Thread-local position of one access; elementwise over arrays.
+
+        `m` is the thread-local parallel-iteration index; n1/n2 are
+        normalized inner-loop indices (ignored beyond the ref's level).
+        """
+        t = self.tables
+        level = int(t.ref_levels[ref_idx])
+        p = m * int(t.acc_per_level[0]) + int(t.ref_offsets[ref_idx])
+        if level >= 1:
+            p = p + self.npre[0] + n1 * int(t.acc_per_level[1])
+        if level >= 2:
+            p = p + self.npre[1] + n2 * int(t.acc_per_level[2])
+        return p
+
+    def ref_flat(self, ref_idx: int, v0, v1=0, v2=0):
+        """Affine flat element index from loop *values* (not normalized)."""
+        t = self.tables
+        c = t.ref_coeffs[ref_idx]
+        return v0 * int(c[0]) + v1 * int(c[1]) + v2 * int(c[2]) + int(t.ref_consts[ref_idx])
+
+    def ref_addr(self, ref_idx: int, v0, v1=0, v2=0):
+        """Cache-line address: flat*DS//CLS (GetAddress_*, ...ri-omp-seq.cpp:12-35)."""
+        m = self.machine
+        return self.ref_flat(ref_idx, v0, v1, v2) * m.ds // m.cls
+
+    def iter_values(self, level: int, n):
+        lp = self.nest.loops[level]
+        return lp.start + n * lp.step
+
+    def ref_space(self, ref_idx: int) -> tuple[int, ...]:
+        """Iteration-space shape of one ref (trips of its enclosing loops)."""
+        level = int(self.tables.ref_levels[ref_idx])
+        return tuple(lp.trip for lp in self.nest.loops[: level + 1])
+
+    def enumerate_ref(self, tid: int, ref_idx: int):
+        """All accesses of (tid, ref): returns (positions, addrs) int64.
+
+        Vectorized numpy enumeration; the concatenation over refs is the
+        thread's complete access stream (in arbitrary order — the
+        position array carries the ordering).
+        """
+        level = int(self.tables.ref_levels[ref_idx])
+        L = self.schedule.local_count(tid)
+        if L == 0:
+            z = np.zeros(0, dtype=np.int64)
+            return z, z.copy()
+        m = np.arange(L, dtype=np.int64)
+        v0 = self.schedule.local_to_value(tid, m)
+        if level == 0:
+            pos = self.access_position(ref_idx, m)
+            addr = self.ref_addr(ref_idx, v0)
+            return pos.astype(np.int64), addr.astype(np.int64)
+        t1 = self.nest.loops[1].trip
+        n1 = np.arange(t1, dtype=np.int64)
+        if level == 1:
+            pos = self.access_position(ref_idx, m[:, None], n1[None, :])
+            addr = self.ref_addr(
+                ref_idx, v0[:, None], self.iter_values(1, n1)[None, :]
+            )
+            addr = np.broadcast_to(addr, pos.shape)
+            return pos.ravel().astype(np.int64), addr.ravel().astype(np.int64)
+        t2 = self.nest.loops[2].trip
+        n2 = np.arange(t2, dtype=np.int64)
+        pos = self.access_position(
+            ref_idx, m[:, None, None], n1[None, :, None], n2[None, None, :]
+        )
+        addr = self.ref_addr(
+            ref_idx,
+            v0[:, None, None],
+            self.iter_values(1, n1)[None, :, None],
+            self.iter_values(2, n2)[None, None, :],
+        )
+        addr = np.broadcast_to(addr, pos.shape)
+        return pos.ravel().astype(np.int64), addr.ravel().astype(np.int64)
+
+
+class ProgramTrace:
+    """Trace geometry of a whole program (nests concatenated per thread).
+
+    The per-thread access clock persists across parallel nests (the
+    reference keeps one `count` array across generated parallel loops),
+    so nest k's positions are offset by the thread's total length of
+    nests 0..k-1.
+    """
+
+    def __init__(self, program: Program, machine: MachineConfig):
+        self.program = program
+        self.machine = machine
+        self.nests = [
+            NestTrace(program, i, machine) for i in range(len(program.nests))
+        ]
+        P = machine.thread_num
+        lengths = np.array(
+            [[nt.tid_length(t) for t in range(P)] for nt in self.nests],
+            dtype=np.int64,
+        )  # (n_nests, P)
+        self.nest_offsets = np.concatenate(
+            [np.zeros((1, P), dtype=np.int64), np.cumsum(lengths, axis=0)]
+        )  # (n_nests+1, P)
+
+    def tid_total_length(self, tid: int) -> int:
+        return int(self.nest_offsets[-1, tid])
+
+    def nest_offset(self, nest_index: int, tid: int) -> int:
+        return int(self.nest_offsets[nest_index, tid])
+
+    def enumerate_tid(self, tid: int):
+        """Full access stream of one simulated thread across all nests.
+
+        Returns int64 arrays (positions, addrs, array_ids, ref_gids)
+        where ref_gids index `self.program.refs`.
+        """
+        pos_all: list[np.ndarray] = []
+        addr_all: list[np.ndarray] = []
+        arr_all: list[np.ndarray] = []
+        ref_all: list[np.ndarray] = []
+        gid = 0
+        for k, nt in enumerate(self.nests):
+            off = self.nest_offset(k, tid)
+            for ri in range(nt.tables.n_refs):
+                pos, addr = nt.enumerate_ref(tid, ri)
+                pos_all.append(pos + off)
+                addr_all.append(addr)
+                arr_all.append(
+                    np.full(pos.shape, nt.tables.ref_arrays[ri], dtype=np.int64)
+                )
+                ref_all.append(np.full(pos.shape, gid, dtype=np.int64))
+                gid += 1
+        return (
+            np.concatenate(pos_all),
+            np.concatenate(addr_all),
+            np.concatenate(arr_all),
+            np.concatenate(ref_all),
+        )
+
+    def ref_global_tables(self):
+        """Program-wide ref tables: share thresholds/ratios per ref gid."""
+        thr: list[int] = []
+        ratio: list[int] = []
+        names: list[str] = []
+        for nt in self.nests:
+            thr.extend(int(x) for x in nt.tables.ref_share_thresholds)
+            ratio.extend(int(x) for x in nt.tables.ref_share_ratios)
+            names.extend(nt.tables.ref_names)
+        return (
+            np.array(thr, dtype=np.int64),
+            np.array(ratio, dtype=np.int64),
+            tuple(names),
+        )

@@ -1,0 +1,22 @@
+"""Benchmark models expressed in the loop-nest IR.
+
+Each function returns a `Program` equivalent to one of the reference's
+generated samplers (or the analogous PolyBench kernel for benchmarks the
+reference's BASELINE configs name but ship no generated sampler for).
+"""
+
+from .gemm import gemm
+from .mm2 import mm2
+from .mm3 import mm3
+from .syrk import syrk_rect
+from .jacobi2d import jacobi2d
+
+REGISTRY = {
+    "gemm": gemm,
+    "2mm": mm2,
+    "3mm": mm3,
+    "syrk": syrk_rect,
+    "jacobi-2d": jacobi2d,
+}
+
+__all__ = ["gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "REGISTRY"]

@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU platform before jax loads.
+
+Multi-chip sharding tests run on a virtual CPU mesh
+(xla_force_host_platform_device_count) exactly as the driver's
+dryrun validates the multi-chip path; real-TPU benching happens outside
+the test suite (bench.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,58 @@
+"""AET/MRC: literal loop vs run-based evaluation, and sanity properties."""
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc, mrc_l1_error
+from pluss_sampler_optimization_tpu.runtime.report import mrc_lines
+
+
+def random_hist(rng, n_keys, max_exp=18, with_cold=True):
+    keys = np.unique(2 ** rng.integers(0, max_exp, size=n_keys))
+    h = {int(k): float(rng.integers(1, 1000)) for k in keys}
+    if with_cold:
+        h[-1] = float(rng.integers(1, 500))
+    return h
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_literal_equals_runs(seed):
+    rng = np.random.default_rng(seed)
+    h = random_hist(rng, 12)
+    machine = MachineConfig(cache_kb=64)  # keep literal loop small
+    a = aet_mrc(h, machine, force="literal")
+    b = aet_mrc(h, machine, force="runs")
+    assert len(a) == len(b)
+    assert np.array_equal(a, b)  # bit-exact
+
+
+def test_mrc_monotone_and_bounded():
+    rng = np.random.default_rng(7)
+    h = random_hist(rng, 10)
+    mrc = aet_mrc(h, MachineConfig(cache_kb=64))
+    assert mrc[0] == 1.0
+    assert (mrc >= 0).all() and (mrc <= 1).all()
+    assert (np.diff(mrc) <= 1e-12).all()  # non-increasing
+
+
+def test_mrc_all_cold():
+    # Only cold misses: P(t) = 1 everywhere it's defined -> flat curve
+    mrc = aet_mrc({-1: 10.0}, MachineConfig())
+    assert mrc[0] == 1.0
+
+
+def test_mrc_lines_run_length():
+    mrc = np.array([1.0, 1.0, 0.5, 0.5, 0.5, 0.1])
+    lines = mrc_lines(mrc)
+    assert lines[0] == "miss ratio"
+    assert lines[1].startswith("0,")
+    assert lines[2].startswith("1,")
+    assert lines[3].startswith("2,")
+    assert lines[4].startswith("4,")
+    assert lines[5].startswith("5,")
+
+
+def test_l1_error_zero_on_equal():
+    mrc = np.array([1.0, 0.5, 0.2])
+    assert mrc_l1_error(mrc, mrc) == 0.0

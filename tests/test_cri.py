@@ -1,0 +1,159 @@
+"""CRI model unit tests: NBD spread, racetrack split, distribute."""
+
+import math
+
+import pytest
+
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.runtime.cri import (
+    R10Quirks,
+    cri_distribute,
+    nbd_spread,
+    negative_binomial_pmf,
+    noshare_distribute,
+    racetrack,
+)
+from pluss_sampler_optimization_tpu.runtime.hist import (
+    PRIState,
+    hist_update,
+    pow2_floor,
+    share_classify,
+)
+
+
+def test_pow2_floor():
+    assert pow2_floor(1) == 1
+    assert pow2_floor(2) == 2
+    assert pow2_floor(3) == 2
+    assert pow2_floor(16513) == 16384
+    assert pow2_floor(2**40 + 5) == 2**40
+
+
+def test_hist_update_binning():
+    h = {}
+    hist_update(h, 514, 1.0)  # pow2 round-down
+    hist_update(h, 512, 2.0)
+    hist_update(h, -1, 3.0)  # negative keys bypass binning
+    assert h == {512: 3.0, -1: 3.0}
+
+
+def test_share_classify_gemm_thresholds():
+    thr = (1 * 128 + 1) * 128 + 1  # 16513, ...ri-omp-seq.cpp:203
+    assert thr == 16513
+    assert not share_classify(514, thr)  # private B reuse
+    assert share_classify(62194, thr)  # cross-c0 B reuse
+    assert share_classify(16513, thr)
+    assert not share_classify(8256, thr)  # below midpoint
+    assert share_classify(8258, thr)
+
+
+def test_nbd_pmf_against_direct_formula():
+    # pmf(k; p, n) = C(n+k-1, k) p^n (1-p)^k for integer n
+    p, n = 0.25, 5
+    for k in range(0, 20):
+        direct = math.comb(n + k - 1, k) * p**n * (1 - p) ** k
+        assert negative_binomial_pmf(k, p, n) == pytest.approx(direct, rel=1e-12)
+
+
+def test_nbd_spread_small_n():
+    d = nbd_spread(4, 10, thread_num=4)
+    assert min(d) == 10  # k=0 bin sits at n
+    assert sum(d.values()) > 0.9999
+    assert sum(d.values()) <= 1.0 + 1e-9
+
+
+def test_nbd_spread_point_mass():
+    # n >= 4000*(T-1)/T -> point mass at THREAD_NUM*n (pluss_utils.h:993-998)
+    d = nbd_spread(4, 5000, thread_num=4)
+    assert d == {20000: 1.0}
+    # r10 variant bins the point mass (rs-ri-opt-r10.cpp:48-52)
+    d = nbd_spread(4, 5000, thread_num=4, point_mass_pow2=True)
+    assert d == {4 * 4096: 1.0}
+
+
+def test_noshare_distribute_negative_passthrough():
+    rih = {}
+    noshare_distribute({-1: 7.0}, rih, 4, 4)
+    assert rih == {-1: 7.0}
+
+
+def test_noshare_distribute_single_thread_identity():
+    rih = {}
+    noshare_distribute({100: 2.0}, rih, 1, 4)
+    assert rih == {64: 2.0}  # pow2-binned on insert into _RIHist
+
+
+def test_racetrack_split_probabilities():
+    # For ri'=8, n=3: P(2^{i-1} <= ri < 2^i) = (1-2^{i-1}/8)^3 - (1-2^i/8)^3
+    state = PRIState(4)
+    state.update_share(0, 3, 8, 1.0)
+    rih = {}
+    # thread_cnt=1 -> passthrough
+    racetrack(state.merged_share(), rih, 1, 4)
+    assert rih == {8: 1.0}
+    # thread_cnt>1: NBD spread then split; use quirks to force the
+    # degenerate point mass so the split input is deterministic (4*8=32)
+    rih = {}
+    racetrack(
+        state.merged_share(), rih, 4, 4,
+        quirks=R10Quirks(share_exponent_minus_one=False, share_nbd_degenerate=True),
+        in_log_format=True,
+    )
+    n = 3.0
+    ri = 32
+    expected = {}
+    probs = {}
+    s = 0.0
+    for i in range(1, 6):  # 2^5 = 32 <= 32
+        probs[i] = (1 - 2 ** (i - 1) / ri) ** n - (1 - 2**i / ri) ** n
+        s += probs[i]
+    probs[5] = 1 - s  # reference's last-bin overwrite (pluss_utils.h:1088-1093)
+    for i, p in probs.items():
+        k = 2 ** (i - 1)
+        expected[k] = expected.get(k, 0.0) + p
+    assert set(rih) == set(expected)
+    for k in expected:
+        assert rih[k] == pytest.approx(expected[k], rel=1e-12)
+
+
+def test_cri_distribute_mass_conservation():
+    # Noshare mass is preserved up to the 0.9999 NBD cutoff. Share mass
+    # is NOT: the reference's racetrack overwrites the last bin with
+    # 1 - prob_sum where prob_sum already includes that bin
+    # (pluss_utils.h:1088-1093), discarding the bin's own probability.
+    state = PRIState(4)
+    for t in range(4):
+        state.update_noshare(t, 514, 10.0)
+        state.update_noshare(t, -1, 3.0)
+    rih = cri_distribute(state, 4, 4)
+    assert sum(rih.values()) == pytest.approx(state.total_counts(), rel=2e-4)
+
+    share_state = PRIState(4)
+    for t in range(4):
+        share_state.update_share(t, 3, 62194, 2.0)
+    rih = cri_distribute(share_state, 4, 4)
+    # NBD point mass at 4n = 248776; split bins i=1..17; reference keeps
+    # 1 - sum(p_1..p_17) in bin 17 instead of p_17.
+    ri, n = 4 * 62194, 3.0
+    probs = [
+        (1 - 2 ** (i - 1) / ri) ** n - (1 - 2**i / ri) ** n for i in range(1, 18)
+    ]
+    expected_total = sum(probs[:-1]) + (1 - sum(probs))
+    assert sum(rih.values()) == pytest.approx(8.0 * expected_total, rel=1e-9)
+
+
+def test_r10_degenerate_share_path():
+    state = PRIState(4)
+    state.update_share(0, 3, 62194, 1.0)
+    rih = {}
+    racetrack(state.merged_share(), rih, 4, 4, quirks=R10Quirks(),
+              in_log_format=False)
+    # point mass at 4*pow2_floor(62194) = 4*32768 = 131072 = 2^17, then
+    # split with exponent n-1=2; last-bin overwrite discards p_17 = 0.25
+    ri, e = 131072, 2.0
+    probs = [
+        (1 - 2 ** (i - 1) / ri) ** e - (1 - 2**i / ri) ** e for i in range(1, 18)
+    ]
+    expected_total = sum(probs[:-1]) + (1 - sum(probs))
+    assert sum(rih.values()) == pytest.approx(expected_total, rel=1e-9)
+    assert max(rih) <= 131072

@@ -1,0 +1,77 @@
+"""StaticSchedule closed forms vs a brute-force ChunkDispatcher emulation.
+
+The emulation follows pluss_utils.h:298-317 (init), :386-391
+(hasNextStaticChunk), :410-425 (getNextStaticChunk) literally.
+"""
+
+import pytest
+
+from pluss_sampler_optimization_tpu.core.schedule import StaticSchedule
+
+
+def dispatcher_walk(trip, chunk, threads, start=0, step=1):
+    """Values each tid visits, per the reference dispatcher."""
+    last = start + (trip - 1) * step
+    sp = [start + (chunk * step) * t for t in range(threads)]
+    out = {t: [] for t in range(threads)}
+    for t in range(threads):
+        while (step > 0 and sp[t] <= last) or (step < 0 and sp[t] >= last):
+            lb = sp[t]
+            ub = lb + (chunk - 1) * step
+            if step > 0:
+                ub = min(ub, last)
+            else:
+                ub = max(ub, last)
+            v = lb
+            while (step > 0 and v <= ub) or (step < 0 and v >= ub):
+                out[t].append(v)
+                v += step
+            sp[t] += chunk * threads * step
+    return out
+
+
+CASES = [
+    (128, 4, 4, 0, 1),
+    (13, 4, 4, 0, 1),
+    (8, 4, 4, 0, 1),
+    (3, 4, 4, 0, 1),
+    (17, 3, 4, 0, 1),
+    (126, 4, 4, 1, 1),  # jacobi-style start=1
+    (30, 5, 3, 2, 1),
+    (16, 4, 2, 0, 1),
+    (1, 4, 4, 0, 1),
+]
+
+
+@pytest.mark.parametrize("trip,chunk,threads,start,step", CASES)
+def test_local_enumeration_matches_dispatcher(trip, chunk, threads, start, step):
+    ref = dispatcher_walk(trip, chunk, threads, start, step)
+    s = StaticSchedule(trip=trip, chunk=chunk, threads=threads, start=start, step=step)
+    for t in range(threads):
+        assert s.local_count(t) == len(ref[t])
+        got = [s.local_to_value(t, m) for m in range(s.local_count(t))]
+        assert got == ref[t]
+
+
+@pytest.mark.parametrize("trip,chunk,threads,start,step", CASES)
+def test_forward_maps_roundtrip(trip, chunk, threads, start, step):
+    s = StaticSchedule(trip=trip, chunk=chunk, threads=threads, start=start, step=step)
+    for n in range(trip):
+        v = s.value(n)
+        assert s.normalize(v) == n
+        t = s.owner_tid(n)
+        m = s.local_index(n)
+        assert s.local_to_normalized(t, m) == n
+        assert s.local_to_value(t, m) == v
+
+
+def test_owner_matches_reference_formula():
+    # getStaticTid (pluss_utils.h:429-431) for the canonical config
+    import math
+
+    s = StaticSchedule(trip=128, chunk=4, threads=4)
+    for i in range(128):
+        tid_ref = (i // 4) - math.floor(i / (4 * 4)) * 4
+        assert s.owner_tid(i) == tid_ref
+        assert s.local_chunk_id(i) == math.floor(i / 16)
+        assert s.chunk_pos(i) == i % 4

@@ -1,0 +1,73 @@
+"""Trace codec invariants: positions are a bijection onto [0, tid_length)."""
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+from pluss_sampler_optimization_tpu.models import gemm, jacobi2d, mm2, mm3, syrk_rect
+
+PROGRAMS = [
+    gemm(8),
+    gemm(13),
+    gemm(16, ni=12, nj=8, nk=10),
+    mm2(8),
+    mm3(6),
+    syrk_rect(8),
+    jacobi2d(10, tsteps=2),
+]
+
+
+@pytest.mark.parametrize("program", PROGRAMS, ids=lambda p: p.name)
+def test_positions_are_bijection(program):
+    machine = MachineConfig()
+    trace = ProgramTrace(program, machine)
+    for tid in range(machine.thread_num):
+        pos, addr, arr, ref = trace.enumerate_tid(tid)
+        n = trace.tid_total_length(tid)
+        assert len(pos) == n
+        got = np.sort(pos)
+        assert np.array_equal(got, np.arange(n, dtype=np.int64))
+        assert (addr >= 0).all()
+
+
+def test_gemm_acc_counts():
+    # GEMM body: 2 accesses per (c0,c1) + 4 per (c0,c1,c2)
+    # (...ri-omp-seq.cpp:102-265): acc[1] = 4N+2, acc[0] = N*(4N+2).
+    program = gemm(128)
+    nest = program.nests[0]
+    acc = nest.accesses_per_level_iter()
+    assert acc == (128 * (4 * 128 + 2), 4 * 128 + 2, 4)
+    # total accesses = N^2*(4N+2) = 4*N^3 + 2*N^2
+    machine = MachineConfig()
+    trace = ProgramTrace(program, machine)
+    total = sum(trace.tid_total_length(t) for t in range(4))
+    assert total == 4 * 128**3 + 2 * 128**2
+
+
+def test_access_position_matches_walk_order():
+    """Positions must equal the literal state-machine visit order."""
+    from pluss_sampler_optimization_tpu.core.schedule import StaticSchedule
+
+    program = gemm(8)
+    machine = MachineConfig()
+    trace = ProgramTrace(program, machine)
+    nt = trace.nests[0]
+    nest = program.nests[0]
+    for tid in range(4):
+        sched = nt.schedule
+        visit = []  # (ref_gid, addr) in literal walk order
+        for m in range(sched.local_count(tid)):
+            c0 = sched.local_to_value(tid, m)
+            for c1 in range(8):
+                visit.append((0, nt.ref_addr(0, c0, c1)))  # C0
+                visit.append((1, nt.ref_addr(1, c0, c1)))  # C1
+                for c2 in range(8):
+                    visit.append((2, nt.ref_addr(2, c0, c1, c2)))  # A0
+                    visit.append((3, nt.ref_addr(3, c0, c1, c2)))  # B0
+                    visit.append((4, nt.ref_addr(4, c0, c1, c2)))  # C2
+                    visit.append((5, nt.ref_addr(5, c0, c1, c2)))  # C3
+        pos, addr, arr, ref = trace.enumerate_tid(tid)
+        order = np.argsort(pos)
+        got = list(zip(ref[order].tolist(), addr[order].tolist()))
+        assert got == visit
