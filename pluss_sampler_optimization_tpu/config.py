@@ -78,13 +78,17 @@ class SamplerConfig:
     # per (ref, shard) before host-side exact sparse accumulation.
     max_share_values: int = 64
 
-    def num_samples(self, trip: int, depth: int) -> int:
+    def num_samples(self, trips) -> int:
         import math
 
-        base = self.ratio * trip
-        n = int(math.ceil(base**depth))
-        space = max(1, (trip - 1 if self.exclude_last_iteration else trip)) ** depth
-        return max(1, min(n, space))
+        if isinstance(trips, int):
+            trips = (trips,)
+        prod = 1.0
+        space = 1
+        for t in trips:
+            prod *= self.ratio * t
+            space *= max(1, t - 1 if self.exclude_last_iteration else t)
+        return max(1, min(int(math.ceil(prod)), space))
 
 
 DEFAULT_MACHINE = MachineConfig()

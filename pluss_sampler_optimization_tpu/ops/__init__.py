@@ -1,0 +1,3 @@
+from .histogram import exp_bin, fixed_k_unique, N_EXP_BINS
+
+__all__ = ["exp_bin", "fixed_k_unique", "N_EXP_BINS"]

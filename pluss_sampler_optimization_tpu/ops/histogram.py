@@ -1,0 +1,68 @@
+"""Device-side histogram primitives.
+
+The reference accumulates reuse intervals into hash maps
+(`Histogram = unordered_map<long,double>`, pluss_utils.h:25) guarded by
+mutexes or thread-locals (src/unsafe_utils.rs:32-35). Hash maps don't
+vectorize; on TPU the same information is:
+
+- noshare intervals: a dense vector of 64 power-of-two bins — the
+  noshare update pow2-bins on insertion anyway (pluss_utils.h:924-927),
+  so exponent scatter-adds lose nothing;
+- share intervals: raw values are required downstream (the racetrack
+  model uses raw interval lengths, pluss_utils.h:1060-1097), but the
+  affine loop nests produce only a handful of distinct values, so a
+  fixed-capacity sorted-unique reduction returns exact (value, count)
+  pairs plus an overflow flag the host asserts on;
+- cold (-1) counts: per-array scalars.
+
+All outputs are dense, fixed-shape, and psum-able across a device mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+N_EXP_BINS = 64
+
+
+def exp_bin(x):
+    """floor(log2(x)) for positive int64 x, via count-leading-zeros."""
+    return 63 - jax.lax.clz(x.astype(jnp.int64))
+
+
+def exp_hist(values, weights, n_bins: int = N_EXP_BINS):
+    """Scatter-add weights into pow2 exponent bins. values must be > 0
+    where weights are nonzero (masked entries: pass weight 0, value 1)."""
+    e = exp_bin(jnp.maximum(values, 1))
+    return jnp.zeros(n_bins, dtype=jnp.int64).at[e].add(weights.astype(jnp.int64))
+
+
+def fixed_k_unique(values, valid, k: int):
+    """Exact sparse histogram with capacity k over masked int64 values.
+
+    Returns (keys[k], counts[k], n_unique). Invalid entries are pushed
+    to the end via an int64 sentinel; entries beyond capacity are
+    dropped (detect via n_unique > k on host).
+    """
+    sentinel = jnp.int64(2**62)
+    v = jnp.where(valid, values, sentinel)
+    v = jnp.sort(v)
+    first = jnp.concatenate(
+        [jnp.array([True]), v[1:] != v[:-1]]
+    ) & (v != sentinel)
+    seg = jnp.cumsum(first.astype(jnp.int64)) - 1
+    n_unique = seg[-1] + 1 if v.shape[0] else jnp.int64(0)
+    is_valid = v != sentinel
+    seg_c = jnp.where(is_valid, seg, k)  # overflow/invalid -> dropped slot
+    keys = (
+        jnp.full(k + 1, -1, dtype=jnp.int64)
+        .at[jnp.where(first, seg_c, k)]
+        .set(v)[:k]
+    )
+    counts = (
+        jnp.zeros(k + 1, dtype=jnp.int64)
+        .at[seg_c]
+        .add(is_valid.astype(jnp.int64))[:k]
+    )
+    return keys, counts, n_unique

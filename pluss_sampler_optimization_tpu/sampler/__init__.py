@@ -1,0 +1,3 @@
+from .dense import run_dense, dense_nest_outputs
+
+__all__ = ["run_dense", "dense_nest_outputs"]

@@ -1,0 +1,249 @@
+"""Dense (full-traversal) TPU sampler.
+
+The XLA twin of the reference's full-traversal samplers
+(`ri`/`ri-omp`/`ri-omp-seq`/`ri-opt`, c_lib/test/sampler/): every access
+of every simulated thread is enumerated and its reuse interval measured
+exactly. The hash-map walk becomes one sort per (thread, nest):
+
+  1. enumerate each reference's iteration grid -> (position, line) pairs
+     (closed forms, core/trace.py);
+  2. pack (group=(array,line), position, ref) into one int64 key; a
+     single ascending sort then places consecutive accesses to the same
+     line next to each other in trace order;
+  3. reuse intervals are adjacent position differences within groups —
+     exactly `count[tid] - LAT_X[tid][addr]` (...ri-omp-seq.cpp:110);
+  4. scatter-add into dense pow2 histograms; share-classified intervals
+     go through a fixed-capacity exact unique reduction; group starts
+     (cold lines) count into the per-array -1 totals (:305-319).
+
+Everything is jit-compiled; simulated threads are vmapped (each is an
+independent sort, the property the `ri` variant's
+`#pragma omp parallel for` over tids exploits, ...ri.cpp:67-68).
+Thread ragged-ness (short/missing last chunks) is handled by masking
+padded entries into a dedicated invalid group.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MachineConfig
+from ..core.trace import NestTrace, ProgramTrace
+from ..ir import Program
+from ..ops.histogram import N_EXP_BINS, exp_bin, fixed_k_unique
+from ..oracle.serial import OracleResult
+from ..runtime.hist import PRIState
+
+_REF_BITS = 5  # up to 32 refs per nest
+
+
+def _ceil_log2(x: int) -> int:
+    return max(1, int(x - 1).bit_length())
+
+
+def _nest_device_arrays(nt: NestTrace, max_share_values: int):
+    """Build the jitted per-nest kernel: tid -> dense histogram outputs."""
+    t = nt.tables
+    sched = nt.schedule
+    machine = nt.machine
+    lmax = sched.max_local_count()
+    n_arrays = int(t.ref_arrays.max()) + 1 if t.n_refs else 1
+    # static per-tid local counts (device-selectable by tid)
+    local_counts = jnp.array(
+        [sched.local_count(tt) for tt in range(sched.threads)], dtype=jnp.int64
+    )
+    # address bounds over the nest (for key packing); negative flats
+    # would corrupt the packed sort keys, so reject them loudly
+    max_addr = 1
+    for ri in range(t.n_refs):
+        level = int(t.ref_levels[ri])
+        hi = int(t.ref_consts[ri])
+        lo = int(t.ref_consts[ri])
+        for l in range(level + 1):
+            c = int(t.ref_coeffs[ri][l])
+            lo_v = nt.nest.loops[l].start
+            hi_v = nt.nest.loops[l].last
+            hi += max(c * lo_v, c * hi_v)
+            lo += min(c * lo_v, c * hi_v)
+        if lo < 0:
+            raise NotImplementedError(
+                f"ref {t.ref_names[ri]}: affine map can reach negative "
+                f"element index {lo}; negative addresses are unsupported"
+            )
+        if int(t.ref_share_ratios[ri]) >= 8:
+            raise NotImplementedError(
+                f"ref {t.ref_names[ri]}: share ratio "
+                f"{int(t.ref_share_ratios[ri])} >= 8 does not fit the "
+                "packed share key (radix 8)"
+            )
+        max_addr = max(max_addr, hi * machine.ds // machine.cls + 1)
+    n_groups = n_arrays * max_addr + 1  # +1 invalid group
+    pos_bits = _ceil_log2(lmax * int(t.acc_per_level[0]) + 1)
+    grp_bits = _ceil_log2(n_groups + 1)
+    assert grp_bits + pos_bits + _REF_BITS <= 63, "key packing overflow"
+
+    K = machine.chunk_size
+    P = sched.threads
+    step0, start0 = sched.step, sched.start
+
+    def per_tid(tid, zero):
+        # `zero` is a traced 0: mixing it into the index grids keeps
+        # them (and everything downstream) out of XLA's compile-time
+        # constant folder — with no runtime inputs the whole sampler
+        # would be folded into a literal at compile time.
+        keys = []
+        for ri in range(t.n_refs):
+            level = int(t.ref_levels[ri])
+            m = jnp.arange(lmax, dtype=jnp.int64) + zero
+            valid_m = m < local_counts[tid]
+            v0 = ((m // K) * P + tid) * K + (m % K)
+            v0 = start0 + v0 * step0
+            c = t.ref_coeffs[ri]
+            off = int(t.ref_offsets[ri])
+            a0 = int(t.acc_per_level[0])
+            if level == 0:
+                pos = m * a0 + off
+                flat = v0 * int(c[0]) + int(t.ref_consts[ri])
+                valid = valid_m
+            elif level == 1:
+                t1 = nt.nest.loops[1]
+                n1 = jnp.arange(t1.trip, dtype=jnp.int64)
+                v1 = t1.start + n1 * t1.step
+                pos = (
+                    m[:, None] * a0
+                    + nt.npre[0]
+                    + n1[None, :] * int(t.acc_per_level[1])
+                    + off
+                )
+                flat = (
+                    v0[:, None] * int(c[0])
+                    + v1[None, :] * int(c[1])
+                    + int(t.ref_consts[ri])
+                )
+                valid = jnp.broadcast_to(valid_m[:, None], pos.shape)
+            else:
+                t1, t2 = nt.nest.loops[1], nt.nest.loops[2]
+                n1 = jnp.arange(t1.trip, dtype=jnp.int64)
+                n2 = jnp.arange(t2.trip, dtype=jnp.int64)
+                v1 = t1.start + n1 * t1.step
+                v2 = t2.start + n2 * t2.step
+                pos = (
+                    m[:, None, None] * a0
+                    + nt.npre[0]
+                    + n1[None, :, None] * int(t.acc_per_level[1])
+                    + nt.npre[1]
+                    + n2[None, None, :] * int(t.acc_per_level[2])
+                    + off
+                )
+                flat = (
+                    v0[:, None, None] * int(c[0])
+                    + v1[None, :, None] * int(c[1])
+                    + v2[None, None, :] * int(c[2])
+                    + int(t.ref_consts[ri])
+                )
+                valid = jnp.broadcast_to(valid_m[:, None, None], pos.shape)
+            addr = flat * machine.ds // machine.cls
+            grp = jnp.where(
+                valid, int(t.ref_arrays[ri]) * max_addr + addr, n_groups - 1
+            )
+            key = (
+                ((grp << pos_bits) | pos.astype(jnp.int64)) << _REF_BITS
+            ) | ri
+            keys.append(key.ravel())
+        key = jnp.sort(jnp.concatenate(keys))
+        ref_s = (key & ((1 << _REF_BITS) - 1)).astype(jnp.int32)
+        pos_s = (key >> _REF_BITS) & ((1 << pos_bits) - 1)
+        grp_s = key >> (_REF_BITS + pos_bits)
+        is_valid = grp_s != (n_groups - 1)
+        same = jnp.concatenate(
+            [jnp.array([False]), (grp_s[1:] == grp_s[:-1]) & is_valid[1:]]
+        )
+        reuse = jnp.where(
+            same, pos_s - jnp.concatenate([jnp.zeros(1, jnp.int64), pos_s[:-1]]), 0
+        )
+        thr = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)[ref_s]
+        is_share = same & (thr > 0) & (jnp.abs(reuse) > jnp.abs(reuse - thr))
+        is_noshare = same & ~is_share
+
+        e = exp_bin(jnp.maximum(reuse, 1))
+        noshare_hist = jnp.zeros(N_EXP_BINS, dtype=jnp.int64).at[e].add(
+            is_noshare.astype(jnp.int64)
+        )
+        # share: pack (reuse, ratio) so one unique pass keeps both
+        ratio = jnp.array(t.ref_share_ratios, dtype=jnp.int64)[ref_s]
+        share_key = reuse * 8 + ratio
+        sk, sc, n_unique = fixed_k_unique(share_key, is_share, max_share_values)
+        # cold lines: first element of each valid group, per array
+        is_first = is_valid & ~same
+        arr_of = jnp.where(is_valid, grp_s // max_addr, n_arrays)
+        cold = jnp.zeros(n_arrays + 1, dtype=jnp.int64).at[
+            jnp.where(is_first, arr_of, n_arrays)
+        ].add(1)[:n_arrays]
+        n_acc = jnp.sum(is_valid.astype(jnp.int64))
+        return noshare_hist, sk, sc, n_unique, cold, n_acc
+
+    return per_tid
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_program(program: Program, machine: MachineConfig, max_share: int):
+    trace = ProgramTrace(program, machine)
+    fns = [
+        _nest_device_arrays(nt, max_share) for nt in trace.nests
+    ]
+
+    @jax.jit
+    def run(tids, zero):
+        outs = []
+        for fn in fns:
+            outs.append(jax.vmap(fn, in_axes=(0, None))(tids, zero))
+        return outs
+
+    def call():
+        return run(jnp.arange(machine.thread_num), jnp.int64(0))
+
+    return trace, call
+
+
+def dense_nest_outputs(program: Program, machine: MachineConfig,
+                       max_share: int = 64):
+    """Run the jitted dense sampler; returns per-nest, per-tid outputs."""
+    _, run = _compiled_program(program, machine, max_share)
+    return jax.device_get(run())
+
+
+def run_dense(program: Program, machine: MachineConfig,
+              max_share: int = 64) -> OracleResult:
+    """Dense TPU sampler -> host PRIState (same shape as the oracles)."""
+    trace, run = _compiled_program(program, machine, max_share)
+    outs = jax.device_get(run())
+    P = machine.thread_num
+    state = PRIState(P)
+    per_tid = [0] * P
+    for (noshare, sk, sc, n_unique, cold, n_acc) in outs:
+        if int(n_unique.max(initial=0)) > sk.shape[1]:
+            raise RuntimeError(
+                "share-value capacity exceeded; raise max_share "
+                f"(needed {int(n_unique.max())}, have {sk.shape[1]})"
+            )
+        for tid in range(P):
+            h = state.noshare[tid]
+            for e_idx in np.nonzero(noshare[tid])[0]:
+                key = 1 << int(e_idx)
+                h[key] = h.get(key, 0.0) + float(noshare[tid][e_idx])
+            c = int(cold[tid].sum())
+            if c:
+                h[-1] = h.get(-1, 0.0) + float(c)
+            for key, cnt in zip(sk[tid], sc[tid]):
+                if cnt > 0:
+                    reuse, ratio = divmod(int(key), 8)
+                    hs = state.share[tid].setdefault(ratio, {})
+                    hs[reuse] = hs.get(reuse, 0.0) + float(cnt)
+            per_tid[tid] += int(n_acc[tid])
+    return OracleResult(
+        state=state, total_accesses=sum(per_tid), per_tid_accesses=per_tid
+    )

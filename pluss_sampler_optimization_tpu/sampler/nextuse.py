@@ -1,0 +1,292 @@
+"""Closed-form next-use solver — the heart of the sampled TPU engine.
+
+The reference's sampled variant (rs-ri-opt-r10,
+c_lib/test/sampler/gemm-t4-pluss-pro-model-rs-ri-opt-r10.cpp) finds a
+sample's reuse by fast-forwarding the whole interleaved 4-thread walk
+from the sample's chunk (dispatcher.setStartPoint, :233) and stepping
+the state machine until the tracked line is touched again — a serial
+O(trace) scan amortized over samples via a priority queue and en-route
+sample absorption (:546-556). Because reuse intervals are differences
+of the per-thread clock (count[tid] - LAT[tid][addr], :333), the answer
+it computes is exactly:
+
+    RI(sample) = min over same-array refs r' of
+                 (first position p' > p0 in the sample thread's own
+                  stream where r' touches the sample's cache line)
+                 - p0
+
+For affine references in row-major arrays, that "first position" has a
+closed form. Every reference in the PolyBench family factors as
+
+    flat = M*u + v + d,   line A  <=>  flat in [A*W, A*W + W),  W=CLS/DS
+
+with u, v loop variables, M the row stride (>= W) and v's coefficient 1
+(either var may be absent). The solutions are a tiny static candidate
+set: at most ceil((W-1+span_v)/M)+2 values of u, and a window of W
+values of v per u. Each candidate fixes some loop levels; the remaining
+levels are free, and the minimal trace position beyond p0 over a
+(fixed/free)^levels box is mixed-radix successor arithmetic.
+
+So each sample's reuse costs O(candidates) = O(1) integer vector ops —
+no walk, no hash map, no data-dependent loop — vectorized over all
+samples at once. This is the re-design that makes sampling TPU-shaped:
+the reference amortizes a serial scan; we eliminate it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.trace import NestTrace
+
+INF = jnp.int64(2**62)
+
+
+def _cdiv(a, b):
+    """Ceil division for int arrays, exact for negative numerators."""
+    return -((-a) // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class _LevelSpec:
+    """Domain of one loop level in a candidate: fixed to a value (with a
+    validity mask) or free over [0, bound)."""
+
+    fixed: bool
+    value: object = None  # jnp int64 array when fixed
+    valid: object = None  # jnp bool array when fixed
+    bound: object = None  # jnp/int upper bound when free
+
+    @staticmethod
+    def free(bound):
+        return _LevelSpec(fixed=False, bound=bound)
+
+    @staticmethod
+    def fix(value, valid):
+        return _LevelSpec(fixed=True, value=value, valid=valid)
+
+    def min_val(self):
+        """Smallest element, INF-marked when empty/invalid."""
+        if self.fixed:
+            return jnp.where(self.valid, self.value, INF)
+        return jnp.zeros((), dtype=jnp.int64)
+
+    def min_gt(self, x):
+        """Smallest element > x, INF when none."""
+        if self.fixed:
+            ok = self.valid & (self.value > x)
+            return jnp.where(ok, self.value, INF)
+        nxt = jnp.maximum(jnp.int64(0), x + 1)
+        return jnp.where(nxt < self.bound, nxt, INF)
+
+    def eq(self, x):
+        """x if x is in the domain, else INF."""
+        if self.fixed:
+            ok = self.valid & (self.value == x)
+            return jnp.where(ok, x, INF)
+        return jnp.where((x >= 0) & (x < self.bound), x, INF)
+
+    def min_scaled_gt(self, scale, x):
+        """Smallest element v with v*scale > x, INF when none (scale>0)."""
+        if self.fixed:
+            ok = self.valid & (self.value * scale > x)
+            return jnp.where(ok, self.value, INF)
+        nxt = jnp.maximum(jnp.int64(0), x // scale + 1)
+        return jnp.where(nxt < self.bound, nxt, INF)
+
+
+def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
+    """Minimal position of `ref_idx` strictly after p0 over a level box.
+
+    `specs`: list of _LevelSpec, one per level 0..ref.level. Positions
+    follow core/trace.py::access_position. Returns INF where empty.
+    """
+    t = nt.tables
+    lv = int(t.ref_levels[ref_idx])
+    off = int(t.ref_offsets[ref_idx])
+    a0 = int(t.acc_per_level[0])
+    np0, np1 = nt.npre[0], (nt.npre[1] if nt.nest.depth > 1 else 0)
+
+    m0 = p0 // a0
+    r0 = p0 - m0 * a0
+
+    def pos(m, n1=None, n2=None):
+        p = m * a0 + off
+        if lv >= 1:
+            p = p + np0 + n1 * int(t.acc_per_level[1])
+        if lv >= 2:
+            p = p + np1 + n2 * int(t.acc_per_level[2])
+        return p
+
+    def guard(p, *parts):
+        bad = jnp.zeros_like(p, dtype=bool)
+        for q in parts:
+            bad = bad | (q >= INF)
+        return jnp.where(bad, INF, p)
+
+    cands = []
+    if lv == 0:
+        # strategy A: bump m; strategy B: same m, later body offset
+        mA = specs[0].min_gt(m0)
+        cands.append(guard(pos(mA), mA))
+        mB = specs[0].eq(m0)
+        pB = guard(pos(mB), mB)
+        cands.append(jnp.where(pB > p0, pB, INF))
+        return jnp.minimum(*cands) if len(cands) > 1 else cands[0]
+
+    a1 = int(t.acc_per_level[1])
+    j0 = (r0 - np0) // a1
+    rr0 = r0 - np0 - j0 * a1
+
+    if lv == 1:
+        mA = specs[0].min_gt(m0)
+        n1A = specs[1].min_val()
+        cands.append(guard(pos(mA, n1A), mA, n1A))
+        mB = specs[0].eq(m0)
+        n1B = specs[1].min_gt(j0)
+        cands.append(guard(pos(mB, n1B), mB, n1B))
+        mC = specs[0].eq(m0)
+        n1C = specs[1].eq(j0)
+        pC = guard(pos(mC, n1C), mC, n1C)
+        cands.append(jnp.where(pC > p0, pC, INF))
+    else:
+        a2 = int(t.acc_per_level[2])
+        mA = specs[0].min_gt(m0)
+        n1A = specs[1].min_val()
+        n2A = specs[2].min_val()
+        cands.append(guard(pos(mA, n1A, n2A), mA, n1A, n2A))
+        mB = specs[0].eq(m0)
+        n1B = specs[1].min_gt(j0)
+        n2B = specs[2].min_val()
+        cands.append(guard(pos(mB, n1B, n2B), mB, n1B, n2B))
+        mC = specs[0].eq(m0)
+        n1C = specs[1].eq(j0)
+        # need np1 + n2*a2 + off > rr0
+        n2C = specs[2].min_scaled_gt(a2, rr0 - np1 - off)
+        pC = guard(pos(mC, n1C, n2C), mC, n1C, n2C)
+        cands.append(jnp.where(pC > p0, pC, INF))
+
+    out = cands[0]
+    for c in cands[1:]:
+        out = jnp.minimum(out, c)
+    return out
+
+
+def _ref_row_col(nt: NestTrace, ref_idx: int):
+    """Factor a ref's flat map as M*u + v + d (levels of u/v or None)."""
+    t = nt.tables
+    lv = int(t.ref_levels[ref_idx])
+    nz = [(l, int(t.ref_coeffs[ref_idx][l])) for l in range(lv + 1)
+          if int(t.ref_coeffs[ref_idx][l]) != 0]
+    d = int(t.ref_consts[ref_idx])
+    if len(nz) == 0:
+        return None, None, 0, d
+    if len(nz) == 1:
+        l, c = nz[0]
+        if c == 1:
+            return None, l, 0, d
+        return l, None, c, d
+    if len(nz) != 2:
+        raise NotImplementedError(
+            f"ref {t.ref_names[ref_idx]}: >2 index variables unsupported"
+        )
+    (la, ca), (lb, cb) = nz
+    if abs(ca) < abs(cb):
+        (la, ca), (lb, cb) = (lb, cb), (la, ca)
+    if cb != 1 or ca <= 0:
+        raise NotImplementedError(
+            f"ref {t.ref_names[ref_idx]}: flat map must factor as M*u + v + d"
+        )
+    return la, lb, ca, d
+
+
+def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
+    """Min position > p0 where `sink_idx` touches `line` on thread tid.
+
+    Vectorized over samples (tid, p0, line are arrays). Enumerates the
+    static candidate grid and reduces with min_position_after.
+    """
+    t = nt.tables
+    machine = nt.machine
+    sched = nt.schedule
+    lv = int(t.ref_levels[sink_idx])
+    W = machine.lines_per_element_block
+    big_l, small_l, M, d = _ref_row_col(nt, sink_idx)
+    lo = line * W - d  # target flat-offset band [lo, lo+W)
+
+    # per-sample local-count bound for free level 0
+    local_counts = jnp.array(
+        [sched.local_count(tt) for tt in range(sched.threads)], dtype=jnp.int64
+    )
+    l_bound = local_counts[tid]
+
+    def level_bound(l):
+        return l_bound if l == 0 else jnp.int64(nt.nest.loops[l].trip)
+
+    def spec_from_value(l, value, extra_valid):
+        """Fix level l to loop *value* `value` (normalize + validate)."""
+        lp = nt.nest.loops[l]
+        n = (value - lp.start) // lp.step
+        ok = extra_valid & ((value - lp.start) % lp.step == 0)
+        ok = ok & (n >= 0) & (n < lp.trip)
+        if l == 0:
+            ok = ok & (sched.owner_tid(n) == tid)
+            return _LevelSpec.fix(sched.local_index(n), ok)
+        return _LevelSpec.fix(n, ok)
+
+    def assemble(fixed_vals):
+        """fixed_vals: {level: (value, valid)} -> specs list."""
+        specs = []
+        for l in range(lv + 1):
+            if l in fixed_vals:
+                value, ok = fixed_vals[l]
+                specs.append(spec_from_value(l, value, ok))
+            else:
+                specs.append(_LevelSpec.free(level_bound(l)))
+        return specs
+
+    best = jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+    true_ = jnp.ones(jnp.shape(p0), dtype=bool)
+
+    if big_l is None and small_l is None:
+        ok = (lo <= 0) & (lo > -W)  # flat == d lands in the band
+        p = min_position_after(nt, sink_idx, p0, assemble({}))
+        return jnp.where(ok, p, INF)
+
+    if big_l is None:
+        # v in [lo, lo+W)
+        for k in range(W):
+            v = lo + k
+            specs = assemble({small_l: (v, true_)})
+            best = jnp.minimum(best, min_position_after(nt, sink_idx, p0, specs))
+        return best
+
+    # big var present: u candidates
+    sl = nt.nest.loops[small_l] if small_l is not None else None
+    if sl is not None:
+        s_min = min(sl.start, sl.last)
+        s_max = max(sl.start, sl.last)
+    else:
+        s_min = s_max = 0
+    u_min = _cdiv(lo - s_max, M)
+    u_max = (lo + W - 1 - s_min) // M
+    n_u = int((W - 1 + (s_max - s_min)) // M) + 2  # static bound
+
+    for iu in range(n_u):
+        u = u_min + iu
+        u_ok = u <= u_max
+        if small_l is None:
+            band_ok = (M * u >= lo) & (M * u < lo + W)
+            specs = assemble({big_l: (u, u_ok & band_ok)})
+            best = jnp.minimum(best, min_position_after(nt, sink_idx, p0, specs))
+        else:
+            for k in range(W):
+                v = lo + k - M * u
+                specs = assemble({big_l: (u, u_ok), small_l: (v, u_ok)})
+                best = jnp.minimum(
+                    best, min_position_after(nt, sink_idx, p0, specs)
+                )
+    return best

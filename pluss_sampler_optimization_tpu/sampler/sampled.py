@@ -1,0 +1,272 @@
+"""Random-start sampled TPU sampler — the r10 equivalent, vectorized.
+
+Reproduces the capabilities of the reference's sampled variant
+(c_lib/test/sampler/gemm-t4-pluss-pro-model-rs-ri-opt-r10.cpp):
+
+- one sampler per static reference (the reference spawns six OS threads,
+  :3203-3251; here each ref is one jitted vector program — the natural
+  TPU analog of that task parallelism, and the axis the multi-chip
+  path shards);
+- num_samples = ceil(prod_l ratio*trip_l): reproduces the generated
+  constants 2098 (3-deep) / 164 (2-deep) at N=128, ratio 10% (:156,
+  :1688);
+- samples drawn uniformly WITHOUT the last iteration of each loop —
+  the generated `rand()%(((N-0)/1-((N-0)%1==0)))` draws from
+  [0, trip-1) (:159-169); kept behind
+  SamplerConfig.exclude_last_iteration;
+- duplicate samples are redrawn (sample_names dedupe, :177);
+- each sample's reuse interval is the forward distance, in its
+  simulated thread's private access clock, to the next same-array
+  touch of its cache line (count[tid] - LAT[tid][addr], :333) — here a
+  closed-form solve (sampler/nextuse.py) instead of a fast-forwarded
+  walk;
+- samples whose line is never touched again before the nest's trace
+  ends flush as -1 (:196, :671);
+- share classification at the sink reference's carried threshold
+  (:2482 for B0), recorded at ratio THREAD_NUM-1.
+
+Outputs are exact sparse (reuse, count) pairs per tracked reference via
+a fixed-capacity unique reduction, so the host can apply either the
+runtime-v1 distribute (default; pluss_utils.h:1204-1208) or the r10
+local distribute quirks (runtime/cri.py::R10Quirks) without loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MachineConfig, SamplerConfig
+from ..core.trace import NestTrace, ProgramTrace
+from ..ir import Program
+from ..ops.histogram import fixed_k_unique
+from ..runtime.hist import PRIState
+from .nextuse import INF, next_use_candidates
+
+_RATIO_SLOTS = 16  # packed key = reuse * 16 + (ratio | noshare-slot 15)
+_NOSHARE_SLOT = _RATIO_SLOTS - 1
+
+
+@dataclasses.dataclass
+class SampledRefResult:
+    """Exact per-tracked-ref sampled histograms (host form)."""
+
+    name: str
+    noshare: dict  # raw reuse -> count (bin on insertion for v1 parity)
+    share: dict  # ratio -> {raw reuse -> count}
+    cold: float  # samples with no further touch (-1 multiplicity)
+    n_samples: int
+
+
+def draw_samples(
+    nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig, seed: int
+) -> np.ndarray:
+    """Dedup'd uniform normalized iteration tuples, shape (S, depth)."""
+    lv = int(nest_trace.tables.ref_levels[ref_idx])
+    trips = [nest_trace.nest.loops[l].trip for l in range(lv + 1)]
+    highs = [
+        max(1, t - 1 if cfg.exclude_last_iteration else t) for t in trips
+    ]
+    s = cfg.num_samples(tuple(trips))
+    rng = np.random.default_rng(seed)
+    seen: set[int] = set()
+    out = []
+    while len(out) < s:
+        batch = np.stack(
+            [rng.integers(0, h, size=max(64, s)) for h in highs], axis=1
+        )
+        key = batch[:, 0]
+        for col in range(1, batch.shape[1]):
+            key = key * highs[col] + batch[:, col]
+        for row, k in zip(batch, key.tolist()):
+            if k not in seen:
+                seen.add(k)
+                out.append(row)
+                if len(out) == s:
+                    break
+    return np.array(out, dtype=np.int64)
+
+
+def _build_ref_kernel(nt: NestTrace, ref_idx: int):
+    """jitted (samples, weights) -> packed unique pairs + cold count."""
+    t = nt.tables
+    for j in range(t.n_refs):
+        if int(t.ref_share_ratios[j]) >= _NOSHARE_SLOT:
+            raise NotImplementedError(
+                f"ref {t.ref_names[j]}: share ratio "
+                f"{int(t.ref_share_ratios[j])} collides with the packed "
+                f"noshare slot (must be < {_NOSHARE_SLOT})"
+            )
+
+    @functools.partial(jax.jit, static_argnames=("capacity",))
+    def kernel(samples, weights, capacity: int):
+        tid, p0, line = _sample_geometry(nt, ref_idx, samples)
+        best, best_sink = _best_sink(nt, ref_idx, tid, p0, line)
+        found = best < INF
+        ri = jnp.where(found, best - p0, 0)
+        thr = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)[best_sink]
+        ratio = jnp.array(t.ref_share_ratios, dtype=jnp.int64)[best_sink]
+        is_share = found & (thr > 0) & (jnp.abs(ri) > jnp.abs(ri - thr))
+        slot = jnp.where(is_share, ratio, _NOSHARE_SLOT)
+        packed = ri * _RATIO_SLOTS + slot
+        w = weights.astype(bool)
+        keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
+        cold = jnp.sum((~found & w).astype(jnp.int64))
+        return keys, counts, n_unique, cold
+
+    return kernel
+
+
+def _sample_geometry(nt: NestTrace, ref_idx: int, samples):
+    """Sample tuples -> (tid, p0, line) in the thread-local trace."""
+    t = nt.tables
+    sched = nt.schedule
+    lv = int(t.ref_levels[ref_idx])
+    n = [samples[:, l] for l in range(lv + 1)]
+    tid = sched.owner_tid(n[0])
+    m = sched.local_index(n[0])
+    vals = [
+        nt.nest.loops[l].start + n[l] * nt.nest.loops[l].step
+        for l in range(lv + 1)
+    ]
+    p0 = nt.access_position(
+        ref_idx, m, n[1] if lv >= 1 else 0, n[2] if lv >= 2 else 0
+    )
+    flat = jnp.full_like(p0, int(t.ref_consts[ref_idx]))
+    for l in range(lv + 1):
+        flat = flat + vals[l] * int(t.ref_coeffs[ref_idx][l])
+    line = flat * nt.machine.ds // nt.machine.cls
+    return tid, p0, line
+
+
+def _best_sink(nt: NestTrace, ref_idx: int, tid, p0, line):
+    """Min next-use position over same-array sink refs + argmin sink."""
+    t = nt.tables
+    best = jnp.full_like(p0, INF.item())
+    best_sink = jnp.zeros_like(p0, dtype=jnp.int32)
+    for j in range(t.n_refs):
+        if t.ref_arrays[j] != t.ref_arrays[ref_idx]:
+            continue
+        pj = next_use_candidates(nt, j, tid, p0, line)
+        take = pj < best
+        best = jnp.where(take, pj, best)
+        best_sink = jnp.where(take, jnp.int32(j), best_sink)
+    return best, best_sink
+
+
+def per_sample_ri(
+    program: Program, machine: MachineConfig, nest_idx: int, ref_idx: int,
+    samples: np.ndarray,
+):
+    """Debug/tracing surface: per-sample (position, reuse, sink, found).
+
+    The DEBUG builds of the reference print per-sample reuse pairs
+    ("[reuse] src -> sink", ...rs-ri-opt-r10.cpp:566-568); this exposes
+    the same information from the vectorized engine.
+    """
+    trace = ProgramTrace(program, machine)
+    nt = trace.nests[nest_idx]
+    samples = jnp.asarray(np.asarray(samples, dtype=np.int64))
+    tid, p0, line = _sample_geometry(nt, ref_idx, samples)
+    best, best_sink = _best_sink(nt, ref_idx, tid, p0, line)
+    found = best < INF
+    return (
+        np.asarray(p0),
+        np.where(np.asarray(found), np.asarray(best - p0), -1),
+        np.asarray(best_sink),
+        np.asarray(found),
+        np.asarray(tid),
+        np.asarray(line),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _program_kernels(program: Program, machine: MachineConfig):
+    trace = ProgramTrace(program, machine)
+    kernels = []
+    for k, nt in enumerate(trace.nests):
+        for ri in range(nt.tables.n_refs):
+            kernels.append((k, ri, _build_ref_kernel(nt, ri)))
+    return trace, kernels
+
+
+def sampled_outputs(
+    program: Program,
+    machine: MachineConfig,
+    cfg: SamplerConfig,
+    batch: int = 1 << 20,
+    capacity: int = 256,
+):
+    """Run the sampled engine; one SampledRefResult per reference."""
+    trace, kernels = _program_kernels(program, machine)
+    results = []
+    for idx, (k, ri, kernel) in enumerate(kernels):
+        nt = trace.nests[k]
+        name = nt.tables.ref_names[ri]
+        samples = draw_samples(nt, ri, cfg, seed=cfg.seed * 1000003 + idx)
+        noshare: dict[int, float] = {}
+        share: dict[int, dict[int, float]] = {}
+        cold = 0.0
+        for s0 in range(0, len(samples), batch):
+            chunk = samples[s0 : s0 + batch]
+            w = np.ones(len(chunk), dtype=np.int64)
+            if len(chunk) < 16:  # tiny batches: keep shapes happy
+                pad = 16 - len(chunk)
+                chunk = np.concatenate([chunk, np.repeat(chunk[:1], pad, 0)])
+                w = np.concatenate([w, np.zeros(pad, dtype=np.int64)])
+            keys, counts, n_unique, c = jax.device_get(
+                kernel(jnp.asarray(chunk), jnp.asarray(w), capacity)
+            )
+            if int(n_unique) > capacity:
+                raise RuntimeError(
+                    f"sampled ref {name}: unique (reuse,class) pairs "
+                    f"{int(n_unique)} exceed capacity {capacity}"
+                )
+            cold += float(c)
+            for key, cnt in zip(keys.tolist(), counts.tolist()):
+                if cnt <= 0:
+                    continue
+                ri_val, slot = divmod(int(key), _RATIO_SLOTS)
+                if slot == _NOSHARE_SLOT:
+                    noshare[ri_val] = noshare.get(ri_val, 0.0) + cnt
+                else:
+                    h = share.setdefault(slot, {})
+                    h[ri_val] = h.get(ri_val, 0.0) + cnt
+        results.append(
+            SampledRefResult(
+                name=name, noshare=noshare, share=share, cold=cold,
+                n_samples=len(samples),
+            )
+        )
+    return results
+
+
+def run_sampled(
+    program: Program,
+    machine: MachineConfig,
+    cfg: SamplerConfig | None = None,
+    **kw,
+) -> tuple[PRIState, list[SampledRefResult]]:
+    """Sampled engine -> PRIState in runtime-v1 form (noshare pow2-binned
+    on insertion, share raw), all counts attributed to simulated thread
+    0 — the distribute/print stages only ever consume thread-merged
+    histograms (pluss_utils.h:1013-1022, :1042-1058), and the r10
+    variant likewise keeps per-ref (not per-thread) histograms."""
+    from ..runtime.hist import hist_update
+
+    cfg = cfg or SamplerConfig()
+    results = sampled_outputs(program, machine, cfg, **kw)
+    state = PRIState(machine.thread_num)
+    for r in results:
+        for ri_val, cnt in r.noshare.items():
+            state.update_noshare(0, ri_val, cnt)
+        if r.cold:
+            hist_update(state.noshare[0], -1, r.cold, in_log_format=False)
+        for ratio, h in r.share.items():
+            for ri_val, cnt in h.items():
+                state.update_share(0, int(ratio), ri_val, cnt)
+    return state, results

@@ -1,16 +1,27 @@
-"""Test harness: force an 8-device virtual CPU platform before jax loads.
+"""Test harness: force an 8-device virtual CPU platform.
 
 Multi-chip sharding tests run on a virtual CPU mesh
-(xla_force_host_platform_device_count) exactly as the driver's
-dryrun validates the multi-chip path; real-TPU benching happens outside
-the test suite (bench.py).
+(xla_force_host_platform_device_count) exactly as the driver's dryrun
+validates the multi-chip path; real-TPU benching happens outside the
+test suite (bench.py).
+
+This environment auto-registers a TPU PJRT plugin from sitecustomize in
+every interpreter and pins JAX_PLATFORMS to it, so plain env overrides
+are too late by the time conftest runs. Backend creation is lazy,
+though: overriding the jax_platforms *config* here (before any jax
+computation initializes a backend) reliably selects CPU, and XLA_FLAGS
+is read when the CPU client is created, which also hasn't happened yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

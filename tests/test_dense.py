@@ -1,0 +1,43 @@
+"""TPU dense sampler vs numpy oracle: bit-exact histogram parity."""
+
+import pytest
+
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.models import gemm, jacobi2d, mm2, mm3, syrk_rect
+from pluss_sampler_optimization_tpu.oracle import run_numpy
+from pluss_sampler_optimization_tpu.sampler import run_dense
+
+PROGRAMS = [
+    gemm(8),
+    gemm(13),
+    gemm(16),
+    gemm(32),
+    mm2(8),
+    mm3(6),
+    syrk_rect(8),
+    jacobi2d(10, tsteps=2),
+]
+
+
+@pytest.mark.parametrize("program", PROGRAMS, ids=lambda p: p.name)
+def test_dense_matches_numpy(program):
+    machine = MachineConfig()
+    ref = run_numpy(program, machine)
+    got = run_dense(program, machine)
+    assert got.total_accesses == ref.total_accesses
+    assert got.per_tid_accesses == ref.per_tid_accesses
+    for t in range(machine.thread_num):
+        assert got.state.noshare[t] == ref.state.noshare[t], f"tid {t}"
+        assert got.state.share[t] == ref.state.share[t], f"tid {t}"
+
+
+def test_dense_gemm128_full_pipeline():
+    """The PR1 reference config: GEMM full traversal at N=128."""
+    machine = MachineConfig()
+    program = gemm(128)
+    ref = run_numpy(program, machine)
+    got = run_dense(program, machine)
+    assert got.total_accesses == 4 * 128**3 + 2 * 128**2
+    for t in range(4):
+        assert got.state.noshare[t] == ref.state.noshare[t]
+        assert got.state.share[t] == ref.state.share[t]
