@@ -1,0 +1,39 @@
+"""Multi-chip execution layer.
+
+The reference's cross-thread reductions are shared-memory constructs:
+mutex-guarded global histograms (src/utils.rs:13-19, pluss_utils.cpp:4-14),
+thread-local histograms merged at thread exit
+(src/unsafe_utils.rs:32-35,105-151), `omp critical` scalar merges
+(c_lib/test/sampler/gemm-t4-pluss-pro-model-ri-opt.cpp termination block)
+and a join-then-merge of six per-reference histograms
+(...rs-ri-opt-r10.cpp:3258-3276).
+
+The TPU-native equivalent replaces all of them with XLA collectives over
+a `jax.sharding.Mesh`:
+
+- the sampled engine shards the *sample axis* (the reference's serial
+  amortized walk, the big win) with `jax.shard_map`; noshare histograms
+  are dense pow2-bin vectors reduced with `lax.psum` over ICI; share
+  histograms stay exact via per-device fixed-capacity unique pairs
+  merged on host;
+- the dense engine shards its vmapped simulated-thread axis with
+  `NamedSharding` (the `ri` variant's `#pragma omp parallel for` over
+  tids, ...ri.cpp:67-68, as SPMD);
+- multi-host scaling needs no new code: the same mesh spans hosts and
+  XLA routes the psum over ICI within a slice and DCN across slices.
+"""
+
+from .mesh import build_mesh, local_device_count
+from .sharded import (
+    run_dense_sharded,
+    run_sampled_sharded,
+    sampled_outputs_sharded,
+)
+
+__all__ = [
+    "build_mesh",
+    "local_device_count",
+    "run_sampled_sharded",
+    "sampled_outputs_sharded",
+    "run_dense_sharded",
+]

@@ -1,0 +1,37 @@
+"""Device-mesh construction helpers.
+
+One flat axis ("samples") is the framework's scale axis: the sampled
+engine shards sampled iteration points over it and psums histograms
+across it. A single chip is the degenerate 1-device mesh, so every
+engine has exactly one code path regardless of topology.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+SAMPLE_AXIS = "samples"
+
+
+def local_device_count() -> int:
+    """Devices attached to this process (jax.local_device_count)."""
+    return jax.local_device_count()
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    axis: str = SAMPLE_AXIS,
+    devices: Optional[Sequence] = None,
+) -> jax.sharding.Mesh:
+    """A 1-D mesh over the first `n_devices` devices (default: all)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}"
+            )
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), (axis,))

@@ -1,0 +1,197 @@
+"""Mesh-sharded execution of the sampled and dense engines.
+
+Sampled engine (the scale path): samples of one tracked reference are
+sharded over the mesh's sample axis with `jax.shard_map`. Each device
+solves its shard's closed-form next-use (sampler/nextuse.py) locally and
+the reduction happens on-device:
+
+- a dense pow2-binned noshare histogram reduced with `lax.psum` — the
+  TPU-native replacement for the reference's mutex/TLS-merge reductions
+  (src/unsafe_utils.rs:105-151, pluss_utils.cpp:4-14);
+- exact (reuse, class) pairs per device via the fixed-capacity unique
+  reduction, merged on host — these preserve raw interval values so the
+  CRI stage (both runtime-v1 and the r10-quirks variant) sees exactly
+  what the unsharded engine produces;
+- cold-sample counts psum'd to a scalar.
+
+The result is bit-identical to sampler/sampled.py on any mesh size
+(same host-side sample draw, same per-sample math; the unique merge is
+exact), which is the sharded path's correctness test.
+
+Dense engine: the jitted per-tid kernel (sampler/dense.py) is already
+vmapped over simulated threads; `run_dense_sharded` lays that batch axis
+out over the mesh with `NamedSharding` — the `ri` variant's
+`#pragma omp parallel for num_threads(THREAD_NUM)` over tids
+(c_lib/test/sampler/gemm-t4-pluss-pro-model-ri.cpp:67-68) as SPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import MachineConfig, SamplerConfig
+from ..core.trace import NestTrace, ProgramTrace
+from ..ir import Program
+from ..ops.histogram import N_EXP_BINS, exp_hist, fixed_k_unique
+from ..runtime.hist import PRIState
+from ..sampler.dense import run_dense
+from ..sampler.sampled import (
+    SampledRefResult,
+    check_packed_ratios,
+    classify_samples,
+    decode_pairs,
+    draw_samples,
+    fold_results,
+)
+from .mesh import build_mesh
+
+
+def _build_sharded_ref_kernel(
+    nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int
+):
+    """jit(shard_map) kernel: sharded samples -> reduced histograms."""
+    axis = mesh.axis_names[0]
+    check_packed_ratios(nt)
+
+    def local_fn(samples, weights):
+        packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
+        w = weights.astype(bool)
+        # scalable output: dense pow2 noshare histogram, psum over ICI
+        nosh_hist = exp_hist(jnp.maximum(ri, 1), (found & ~is_share & w))
+        nosh_hist = jax.lax.psum(nosh_hist, axis)
+        cold = jax.lax.psum(jnp.sum((~found & w).astype(jnp.int64)), axis)
+        # exact output: per-device unique (reuse, class) pairs
+        keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
+        return nosh_hist, cold, keys, counts, n_unique[None]
+
+    sharded = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P(), P(axis), P(axis), P(axis)),
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_program_kernels(
+    program: Program,
+    machine: MachineConfig,
+    mesh: jax.sharding.Mesh,
+    capacity: int,
+):
+    trace = ProgramTrace(program, machine)
+    kernels = []
+    for k, nt in enumerate(trace.nests):
+        for ri in range(nt.tables.n_refs):
+            kernels.append(
+                (k, ri, _build_sharded_ref_kernel(nt, ri, mesh, capacity))
+            )
+    return trace, kernels
+
+
+def _pad_to_devices(samples: np.ndarray, n_dev: int, min_per_dev: int = 16):
+    """Pad with weight-0 repeats so each device gets an equal shard."""
+    s = len(samples)
+    per_dev = max(min_per_dev, -(-s // n_dev))
+    total = per_dev * n_dev
+    w = np.zeros(total, dtype=np.int64)
+    w[:s] = 1
+    if total > s:
+        samples = np.concatenate(
+            [samples, np.repeat(samples[:1], total - s, axis=0)]
+        )
+    return samples, w
+
+
+def sampled_outputs_sharded(
+    program: Program,
+    machine: MachineConfig,
+    cfg: SamplerConfig | None = None,
+    mesh: jax.sharding.Mesh | None = None,
+    batch: int = 1 << 20,
+    capacity: int = 256,
+):
+    """Sharded sampled engine -> per-ref SampledRefResult (exact) plus
+    the psum'd dense noshare histograms (per ref, for observability)."""
+    cfg = cfg or SamplerConfig()
+    mesh = mesh or build_mesh()
+    n_dev = mesh.devices.size
+    trace, kernels = _sharded_program_kernels(program, machine, mesh, capacity)
+    results = []
+    dense_noshare = []
+    for idx, (k, ri, kernel) in enumerate(kernels):
+        nt = trace.nests[k]
+        name = nt.tables.ref_names[ri]
+        samples = draw_samples(nt, ri, cfg, seed=cfg.seed * 1000003 + idx)
+        noshare: dict[int, float] = {}
+        share: dict[int, dict[int, float]] = {}
+        cold = 0.0
+        dense = np.zeros(N_EXP_BINS, dtype=np.int64)
+        step = max(n_dev, (batch // n_dev) * n_dev)
+        for s0 in range(0, len(samples), step):
+            chunk, w = _pad_to_devices(samples[s0 : s0 + step], n_dev)
+            nh, c, keys, counts, n_unique = jax.device_get(
+                kernel(jnp.asarray(chunk), jnp.asarray(w))
+            )
+            keys = keys.reshape(n_dev, capacity)
+            counts = counts.reshape(n_dev, capacity)
+            if int(n_unique.max(initial=0)) > capacity:
+                raise RuntimeError(
+                    f"sampled ref {name}: unique (reuse,class) pairs "
+                    f"{int(n_unique.max())} exceed capacity {capacity}"
+                )
+            dense += nh
+            cold += float(c)
+            for d in range(n_dev):
+                decode_pairs(keys[d], counts[d], noshare, share)
+        results.append(
+            SampledRefResult(
+                name=name, noshare=noshare, share=share, cold=cold,
+                n_samples=len(samples),
+            )
+        )
+        dense_noshare.append(dense)
+    return results, dense_noshare
+
+
+def run_sampled_sharded(
+    program: Program,
+    machine: MachineConfig,
+    cfg: SamplerConfig | None = None,
+    mesh: jax.sharding.Mesh | None = None,
+    **kw,
+) -> tuple[PRIState, list[SampledRefResult]]:
+    """Sharded engine -> PRIState; bit-identical to sampler/sampled.py's
+    run_sampled on any mesh size (same draw, exact merges)."""
+    cfg = cfg or SamplerConfig()
+    results, _ = sampled_outputs_sharded(program, machine, cfg, mesh, **kw)
+    return fold_results(results, machine.thread_num), results
+
+
+def run_dense_sharded(
+    program: Program,
+    machine: MachineConfig,
+    mesh: jax.sharding.Mesh | None = None,
+    max_share: int = 64,
+):
+    """Dense engine with the simulated-thread axis laid out on the mesh.
+
+    Requires thread_num % mesh size == 0 (each device owns an equal
+    slice of the vmapped tid batch axis). Returns the same OracleResult
+    as sampler/dense.py::run_dense.
+    """
+    mesh = mesh or build_mesh()
+    n_dev = mesh.devices.size
+    if machine.thread_num % n_dev != 0:
+        raise ValueError(
+            f"thread_num {machine.thread_num} not divisible by mesh size "
+            f"{n_dev}; use build_mesh(n_devices=...) with a divisor"
+        )
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    return run_dense(program, machine, max_share, tid_sharding=sharding)

@@ -203,24 +203,31 @@ def _compiled_program(program: Program, machine: MachineConfig, max_share: int):
             outs.append(jax.vmap(fn, in_axes=(0, None))(tids, zero))
         return outs
 
-    def call():
-        return run(jnp.arange(machine.thread_num), jnp.int64(0))
+    return trace, run
 
-    return trace, call
+
+def _run_outputs(program: Program, machine: MachineConfig, max_share: int,
+                 tid_sharding=None):
+    """Execute the jitted program; optionally lay the vmapped simulated-
+    thread batch axis out over a mesh (parallel/sharded.py)."""
+    trace, run = _compiled_program(program, machine, max_share)
+    tids = jnp.arange(machine.thread_num)
+    if tid_sharding is not None:
+        tids = jax.device_put(tids, tid_sharding)
+    return trace, jax.device_get(run(tids, jnp.int64(0)))
 
 
 def dense_nest_outputs(program: Program, machine: MachineConfig,
                        max_share: int = 64):
     """Run the jitted dense sampler; returns per-nest, per-tid outputs."""
-    _, run = _compiled_program(program, machine, max_share)
-    return jax.device_get(run())
+    _, outs = _run_outputs(program, machine, max_share)
+    return outs
 
 
 def run_dense(program: Program, machine: MachineConfig,
-              max_share: int = 64) -> OracleResult:
+              max_share: int = 64, tid_sharding=None) -> OracleResult:
     """Dense TPU sampler -> host PRIState (same shape as the oracles)."""
-    trace, run = _compiled_program(program, machine, max_share)
-    outs = jax.device_get(run())
+    trace, outs = _run_outputs(program, machine, max_share, tid_sharding)
     P = machine.thread_num
     state = PRIState(P)
     per_tid = [0] * P

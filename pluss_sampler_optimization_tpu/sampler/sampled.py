@@ -91,8 +91,8 @@ def draw_samples(
     return np.array(out, dtype=np.int64)
 
 
-def _build_ref_kernel(nt: NestTrace, ref_idx: int):
-    """jitted (samples, weights) -> packed unique pairs + cold count."""
+def check_packed_ratios(nt: NestTrace) -> None:
+    """Every share ratio must fit the packed-key radix."""
     t = nt.tables
     for j in range(t.n_refs):
         if int(t.ref_share_ratios[j]) >= _NOSHARE_SLOT:
@@ -102,17 +102,50 @@ def _build_ref_kernel(nt: NestTrace, ref_idx: int):
                 f"noshare slot (must be < {_NOSHARE_SLOT})"
             )
 
+
+def classify_samples(nt: NestTrace, ref_idx: int, samples):
+    """Per-sample reuse classification (traced JAX math).
+
+    Returns (packed, ri, is_share, found): the packed
+    reuse*_RATIO_SLOTS+slot key, the raw reuse interval, the share
+    classification at the sink's carried threshold
+    (...ri-omp-seq.cpp:203-207) and the found mask (False = the line is
+    never touched again, the -1 flush case, r10 :671). Single source of
+    truth for both the single-device and the mesh-sharded kernels.
+    """
+    t = nt.tables
+    tid, p0, line = _sample_geometry(nt, ref_idx, samples)
+    best, best_sink = _best_sink(nt, ref_idx, tid, p0, line)
+    found = best < INF
+    ri = jnp.where(found, best - p0, 0)
+    thr = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)[best_sink]
+    ratio = jnp.array(t.ref_share_ratios, dtype=jnp.int64)[best_sink]
+    is_share = found & (thr > 0) & (jnp.abs(ri) > jnp.abs(ri - thr))
+    slot = jnp.where(is_share, ratio, _NOSHARE_SLOT)
+    packed = ri * _RATIO_SLOTS + slot
+    return packed, ri, is_share, found
+
+
+def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
+    """Fold device (packed key, count) pairs into host sparse hists."""
+    for key, cnt in zip(keys.tolist(), counts.tolist()):
+        if cnt <= 0:
+            continue
+        ri_val, slot = divmod(int(key), _RATIO_SLOTS)
+        if slot == _NOSHARE_SLOT:
+            noshare[ri_val] = noshare.get(ri_val, 0.0) + cnt
+        else:
+            h = share.setdefault(slot, {})
+            h[ri_val] = h.get(ri_val, 0.0) + cnt
+
+
+def _build_ref_kernel(nt: NestTrace, ref_idx: int):
+    """jitted (samples, weights) -> packed unique pairs + cold count."""
+    check_packed_ratios(nt)
+
     @functools.partial(jax.jit, static_argnames=("capacity",))
     def kernel(samples, weights, capacity: int):
-        tid, p0, line = _sample_geometry(nt, ref_idx, samples)
-        best, best_sink = _best_sink(nt, ref_idx, tid, p0, line)
-        found = best < INF
-        ri = jnp.where(found, best - p0, 0)
-        thr = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)[best_sink]
-        ratio = jnp.array(t.ref_share_ratios, dtype=jnp.int64)[best_sink]
-        is_share = found & (thr > 0) & (jnp.abs(ri) > jnp.abs(ri - thr))
-        slot = jnp.where(is_share, ratio, _NOSHARE_SLOT)
-        packed = ri * _RATIO_SLOTS + slot
+        packed, _, _, found = classify_samples(nt, ref_idx, samples)
         w = weights.astype(bool)
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
         cold = jnp.sum((~found & w).astype(jnp.int64))
@@ -227,15 +260,7 @@ def sampled_outputs(
                     f"{int(n_unique)} exceed capacity {capacity}"
                 )
             cold += float(c)
-            for key, cnt in zip(keys.tolist(), counts.tolist()):
-                if cnt <= 0:
-                    continue
-                ri_val, slot = divmod(int(key), _RATIO_SLOTS)
-                if slot == _NOSHARE_SLOT:
-                    noshare[ri_val] = noshare.get(ri_val, 0.0) + cnt
-                else:
-                    h = share.setdefault(slot, {})
-                    h[ri_val] = h.get(ri_val, 0.0) + cnt
+            decode_pairs(keys, counts, noshare, share)
         results.append(
             SampledRefResult(
                 name=name, noshare=noshare, share=share, cold=cold,
@@ -245,22 +270,17 @@ def sampled_outputs(
     return results
 
 
-def run_sampled(
-    program: Program,
-    machine: MachineConfig,
-    cfg: SamplerConfig | None = None,
-    **kw,
-) -> tuple[PRIState, list[SampledRefResult]]:
-    """Sampled engine -> PRIState in runtime-v1 form (noshare pow2-binned
-    on insertion, share raw), all counts attributed to simulated thread
-    0 — the distribute/print stages only ever consume thread-merged
-    histograms (pluss_utils.h:1013-1022, :1042-1058), and the r10
-    variant likewise keeps per-ref (not per-thread) histograms."""
+def fold_results(
+    results: list[SampledRefResult], thread_num: int
+) -> PRIState:
+    """Per-ref sampled results -> PRIState in runtime-v1 form (noshare
+    pow2-binned on insertion, share raw), all counts attributed to
+    simulated thread 0 — the distribute/print stages only ever consume
+    thread-merged histograms (pluss_utils.h:1013-1022, :1042-1058), and
+    the r10 variant likewise keeps per-ref (not per-thread) histograms."""
     from ..runtime.hist import hist_update
 
-    cfg = cfg or SamplerConfig()
-    results = sampled_outputs(program, machine, cfg, **kw)
-    state = PRIState(machine.thread_num)
+    state = PRIState(thread_num)
     for r in results:
         for ri_val, cnt in r.noshare.items():
             state.update_noshare(0, ri_val, cnt)
@@ -269,4 +289,16 @@ def run_sampled(
         for ratio, h in r.share.items():
             for ri_val, cnt in h.items():
                 state.update_share(0, int(ratio), ri_val, cnt)
-    return state, results
+    return state
+
+
+def run_sampled(
+    program: Program,
+    machine: MachineConfig,
+    cfg: SamplerConfig | None = None,
+    **kw,
+) -> tuple[PRIState, list[SampledRefResult]]:
+    """Sampled engine -> PRIState (see fold_results for the v1 form)."""
+    cfg = cfg or SamplerConfig()
+    results = sampled_outputs(program, machine, cfg, **kw)
+    return fold_results(results, machine.thread_num), results

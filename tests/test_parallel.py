@@ -1,0 +1,100 @@
+"""Multi-chip layer: sharded engines must equal the single-device ones.
+
+Runs on the virtual 8-device CPU platform (conftest.py), the same
+configuration the driver's dryrun uses.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.models.mm2 import mm2
+from pluss_sampler_optimization_tpu.parallel import (
+    build_mesh,
+    run_dense_sharded,
+    run_sampled_sharded,
+    sampled_outputs_sharded,
+)
+from pluss_sampler_optimization_tpu.runtime.hist import pow2_floor
+from pluss_sampler_optimization_tpu.sampler.dense import run_dense
+from pluss_sampler_optimization_tpu.sampler.sampled import (
+    run_sampled,
+    sampled_outputs,
+)
+
+MACHINE = MachineConfig()
+
+
+def _states_equal(a, b):
+    assert len(a.noshare) == len(b.noshare)
+    for ha, hb in zip(a.noshare, b.noshare):
+        assert ha == hb
+    for sa, sb in zip(a.share, b.share):
+        assert set(sa) == set(sb)
+        for ratio in sa:
+            assert sa[ratio] == sb[ratio]
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 8])
+def test_sampled_sharded_matches_unsharded(n_dev):
+    prog = gemm(16)
+    cfg = SamplerConfig(ratio=0.25, seed=3)
+    mesh = build_mesh(n_dev)
+    state_ref, results_ref = run_sampled(prog, MACHINE, cfg)
+    state_sh, results_sh = run_sampled_sharded(prog, MACHINE, cfg, mesh)
+    _states_equal(state_ref, state_sh)
+    for ra, rb in zip(results_ref, results_sh):
+        assert ra.name == rb.name
+        assert ra.noshare == rb.noshare
+        assert ra.share == rb.share
+        assert ra.cold == rb.cold
+
+
+def test_sampled_sharded_multinest(eight=8):
+    prog = mm2(8)
+    cfg = SamplerConfig(ratio=0.5, seed=1)
+    state_ref, _ = run_sampled(prog, MACHINE, cfg)
+    state_sh, _ = run_sampled_sharded(prog, MACHINE, cfg, build_mesh(eight))
+    _states_equal(state_ref, state_sh)
+
+
+def test_dense_psum_histogram_matches_exact_pairs():
+    """The psum'd dense noshare histogram must agree with the exact
+    sparse pairs after pow2 binning."""
+    prog = gemm(16)
+    cfg = SamplerConfig(ratio=0.25, seed=3)
+    exact = sampled_outputs(prog, MACHINE, cfg)
+    _, dense = sampled_outputs_sharded(
+        prog, MACHINE, cfg, mesh=build_mesh(8)
+    )
+    for r, nh in zip(exact, dense):
+        from_pairs = {}
+        for ri_val, cnt in r.noshare.items():
+            k = pow2_floor(max(int(ri_val), 1))
+            from_pairs[k] = from_pairs.get(k, 0) + int(cnt)
+        from_dense = {
+            1 << e: int(c) for e, c in enumerate(nh) if c > 0
+        }
+        assert from_pairs == from_dense
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_dense_sharded_matches_unsharded(n_dev):
+    prog = gemm(12)
+    ref = run_dense(prog, MACHINE)
+    sh = run_dense_sharded(prog, MACHINE, mesh=build_mesh(n_dev))
+    assert ref.total_accesses == sh.total_accesses
+    assert ref.per_tid_accesses == sh.per_tid_accesses
+    _states_equal(ref.state, sh.state)
+
+
+def test_dense_sharded_rejects_bad_mesh():
+    with pytest.raises(ValueError):
+        run_dense_sharded(gemm(8), MACHINE, mesh=build_mesh(3))
