@@ -42,11 +42,13 @@ from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
 from ..sampler.sampled import (
     SampledRefResult,
+    check_capacity,
     check_packed_ratios,
     classify_samples,
     decode_pairs,
     draw_samples,
     fold_results,
+    pad_samples,
 )
 from .mesh import build_mesh
 
@@ -95,20 +97,6 @@ def _sharded_program_kernels(
     return trace, kernels
 
 
-def _pad_to_devices(samples: np.ndarray, n_dev: int, min_per_dev: int = 16):
-    """Pad with weight-0 repeats so each device gets an equal shard."""
-    s = len(samples)
-    per_dev = max(min_per_dev, -(-s // n_dev))
-    total = per_dev * n_dev
-    w = np.zeros(total, dtype=np.int64)
-    w[:s] = 1
-    if total > s:
-        samples = np.concatenate(
-            [samples, np.repeat(samples[:1], total - s, axis=0)]
-        )
-    return samples, w
-
-
 def sampled_outputs_sharded(
     program: Program,
     machine: MachineConfig,
@@ -135,17 +123,16 @@ def sampled_outputs_sharded(
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
         for s0 in range(0, len(samples), step):
-            chunk, w = _pad_to_devices(samples[s0 : s0 + step], n_dev)
+            chunk, w = pad_samples(
+                samples[s0 : s0 + step], n_dev,
+                total=step if len(samples) > step else None,
+            )
             nh, c, keys, counts, n_unique = jax.device_get(
                 kernel(jnp.asarray(chunk), jnp.asarray(w))
             )
             keys = keys.reshape(n_dev, capacity)
             counts = counts.reshape(n_dev, capacity)
-            if int(n_unique.max(initial=0)) > capacity:
-                raise RuntimeError(
-                    f"sampled ref {name}: unique (reuse,class) pairs "
-                    f"{int(n_unique.max())} exceed capacity {capacity}"
-                )
+            check_capacity(name, int(n_unique.max(initial=0)), capacity)
             dense += nh
             cold += float(c)
             for d in range(n_dev):

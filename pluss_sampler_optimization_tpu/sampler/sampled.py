@@ -126,6 +126,37 @@ def classify_samples(nt: NestTrace, ref_idx: int, samples):
     return packed, ri, is_share, found
 
 
+def pad_samples(
+    samples: np.ndarray, n_dev: int, min_per_dev: int = 16,
+    total: int | None = None,
+):
+    """Pad with weight-0 repeats of row 0 so each of n_dev equal shards
+    gets at least min_per_dev rows (or exactly total/n_dev when `total`
+    is given, to keep one compiled shape across batch chunks)."""
+    s = len(samples)
+    if total is None:
+        per_dev = max(min_per_dev, -(-s // n_dev))
+        total = per_dev * n_dev
+    assert total % n_dev == 0 and total >= s
+    w = np.zeros(total, dtype=np.int64)
+    w[:s] = 1
+    if total > s:
+        samples = np.concatenate(
+            [samples, np.repeat(samples[:1], total - s, axis=0)]
+        )
+    return samples, w
+
+
+def check_capacity(name: str, n_unique_max: int, capacity: int) -> None:
+    """The fixed-capacity unique reduction drops pairs beyond capacity;
+    the host must reject such runs rather than undercount."""
+    if n_unique_max > capacity:
+        raise RuntimeError(
+            f"sampled ref {name}: unique (reuse,class) pairs "
+            f"{n_unique_max} exceed capacity {capacity}; raise capacity"
+        )
+
+
 def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
     """Fold device (packed key, count) pairs into host sparse hists."""
     for key, cnt in zip(keys.tolist(), counts.tolist()):
@@ -245,20 +276,14 @@ def sampled_outputs(
         share: dict[int, dict[int, float]] = {}
         cold = 0.0
         for s0 in range(0, len(samples), batch):
-            chunk = samples[s0 : s0 + batch]
-            w = np.ones(len(chunk), dtype=np.int64)
-            if len(chunk) < 16:  # tiny batches: keep shapes happy
-                pad = 16 - len(chunk)
-                chunk = np.concatenate([chunk, np.repeat(chunk[:1], pad, 0)])
-                w = np.concatenate([w, np.zeros(pad, dtype=np.int64)])
+            chunk, w = pad_samples(
+                samples[s0 : s0 + batch], 1,
+                total=batch if len(samples) > batch else None,
+            )
             keys, counts, n_unique, c = jax.device_get(
                 kernel(jnp.asarray(chunk), jnp.asarray(w), capacity)
             )
-            if int(n_unique) > capacity:
-                raise RuntimeError(
-                    f"sampled ref {name}: unique (reuse,class) pairs "
-                    f"{int(n_unique)} exceed capacity {capacity}"
-                )
+            check_capacity(name, int(n_unique), capacity)
             cold += float(c)
             decode_pairs(keys, counts, noshare, share)
         results.append(
