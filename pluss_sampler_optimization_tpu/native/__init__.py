@@ -1,0 +1,155 @@
+"""ctypes bindings for the native serial sampler runtime.
+
+The C++ library (pluss_native.cpp) is the framework's native runtime
+component — the TPU-native equivalent of the reference's C++ runtime +
+generated serial sampler (c_lib/test/runtime/pluss_utils.h,
+c_lib/test/sampler/...-ri-omp-seq.cpp), driven by the loop-nest IR
+instead of per-benchmark codegen. It serves as the fast large-N oracle
+and as bench.py's single-core speed baseline.
+
+Built lazily with g++ on first use; `available()` reports whether a
+toolchain/binary exists so callers can fall back to the Python oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from ..config import MachineConfig
+from ..ir import MAX_DEPTH, Program, nest_tables
+from ..oracle.serial import OracleResult
+from ..runtime.hist import PRIState
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libplussnative.so")
+_SRC = os.path.join(_DIR, "pluss_native.cpp")
+
+N_NOSHARE_BINS = 64
+_NOSHARE_SLOTS = N_NOSHARE_BINS + 1  # + the -1 cold bin
+
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def ensure_built(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    stale = (
+        not os.path.exists(_SO)
+        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    )
+    if force or stale:
+        subprocess.run(
+            ["make", "-C", _DIR, "libplussnative.so"],
+            check=True,
+            capture_output=True,
+        )
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    if _build_error is not None:
+        raise RuntimeError(_build_error)
+    try:
+        lib = ctypes.CDLL(ensure_built())
+    except (OSError, subprocess.CalledProcessError) as e:
+        _build_error = f"native runtime unavailable: {e}"
+        raise RuntimeError(_build_error) from e
+    lib.pluss_run_serial.restype = ctypes.c_int64
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _i64(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.int64))
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def run_serial_native(
+    program: Program, machine: MachineConfig, share_cap: int = 1 << 16
+) -> OracleResult:
+    """Native serial walk -> OracleResult, bit-exact vs oracle.run_serial."""
+    lib = _load()
+    n_nests = len(program.nests)
+    tables = [
+        nest_tables(program, k, machine.thread_num - 1)
+        for k in range(n_nests)
+    ]
+    depths = _i64([t.depth for t in tables])
+    trips = _i64(np.stack([t.trips for t in tables]))
+    starts = _i64(np.stack([t.starts for t in tables]))
+    steps = _i64(np.stack([t.steps for t in tables]))
+    ref_off = _i64(np.cumsum([0] + [t.n_refs for t in tables]))
+    levels = _i64(np.concatenate([t.ref_levels for t in tables]))
+    coeffs = _i64(np.concatenate([t.ref_coeffs for t in tables]))
+    consts = _i64(np.concatenate([t.ref_consts for t in tables]))
+    arrays = _i64(np.concatenate([t.ref_arrays for t in tables]))
+    slots = _i64(
+        [
+            0 if r.slot == "pre" else 1
+            for nest in program.nests
+            for r in nest.refs
+        ]
+    )
+    thrs = _i64(np.concatenate([t.ref_share_thresholds for t in tables]))
+    ratios = _i64(np.concatenate([t.ref_share_ratios for t in tables]))
+
+    P = machine.thread_num
+    noshare_bins = np.zeros(P * _NOSHARE_SLOTS, dtype=np.int64)
+    share_out = np.zeros(share_cap * 4, dtype=np.int64)
+    share_count = np.zeros(1, dtype=np.int64)
+    per_tid = np.zeros(P, dtype=np.int64)
+
+    rc = lib.pluss_run_serial(
+        ctypes.c_int64(P),
+        ctypes.c_int64(machine.chunk_size),
+        ctypes.c_int64(machine.ds),
+        ctypes.c_int64(machine.cls),
+        ctypes.c_int64(n_nests),
+        _ptr(depths), _ptr(trips), _ptr(starts), _ptr(steps),
+        _ptr(ref_off), _ptr(levels), _ptr(coeffs), _ptr(consts),
+        _ptr(arrays), _ptr(slots), _ptr(thrs), _ptr(ratios),
+        ctypes.c_int64(len(program.arrays)),
+        _ptr(noshare_bins), _ptr(share_out), _ptr(share_count),
+        ctypes.c_int64(share_cap), _ptr(per_tid),
+    )
+    if rc != 0:
+        raise RuntimeError(
+            f"native share capacity exceeded: need {int(share_count[0])}, "
+            f"have {share_cap}"
+        )
+
+    state = PRIState(P)
+    bins = noshare_bins.reshape(P, _NOSHARE_SLOTS)
+    for tid in range(P):
+        h = state.noshare[tid]
+        for e in np.nonzero(bins[tid, :N_NOSHARE_BINS])[0]:
+            h[1 << int(e)] = float(bins[tid, e])
+        if bins[tid, N_NOSHARE_BINS]:
+            h[-1] = float(bins[tid, N_NOSHARE_BINS])
+    for i in range(int(share_count[0])):
+        tid, ratio, value, cnt = share_out[i * 4 : i * 4 + 4]
+        state.update_share(int(tid), int(ratio), int(value), float(cnt))
+    return OracleResult(
+        state=state,
+        total_accesses=int(per_tid.sum()),
+        per_tid_accesses=[int(x) for x in per_tid],
+    )

@@ -1,0 +1,216 @@
+// Native serial sampler runtime.
+//
+// C++ twin of the reference's serial generated sampler + runtime-v1
+// histogram layer (c_lib/test/sampler/gemm-t4-pluss-pro-model-ri-omp-seq.cpp,
+// c_lib/test/runtime/pluss_utils.h), generalized over the loop-nest IR
+// (pluss_sampler_optimization_tpu/ir.py) instead of generated per
+// benchmark. It plays two roles:
+//
+// 1. fast oracle: bit-exact against the Python serial oracle
+//    (oracle/serial.py) at any size, hundreds of times faster — large-N
+//    parity tests for the TPU engines anchor on it;
+// 2. speed baseline: its single-core walk is the reference protocol's
+//    "serial C++ sampler" (BASELINE.md) that bench.py compares the TPU
+//    engines against.
+//
+// The walk mirrors the reference exactly: per simulated thread, chunks
+// in static dispatch order (pluss_utils.h:410-425), the body reference
+// sequence in program order, a per-(thread, array) last-access-time
+// hash map (LAT_*, ...ri-omp-seq.cpp:47-49), reuse = count[tid] - LAT
+// (:110), share classification |reuse-0| vs |reuse-thr| (:203-207),
+// noshare pow2-binned on insertion (pluss_utils.h:924-927, share kept
+// raw :928-937), and the per-nest -1 flush + LAT clear (:303-319).
+//
+// Exposed as a flat-array C ABI consumed via ctypes (native/__init__.py).
+
+#include <algorithm>
+#include <array>
+#include <cstdint>
+#include <map>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxDepth = 3;
+constexpr int kNoShareBins = 64;  // pow2 exponent bins
+constexpr int kColdBin = kNoShareBins;  // the -1 flush bin
+constexpr int kNoShareSlots = kNoShareBins + 1;
+
+struct Ref {
+  int64_t level;
+  std::array<int64_t, kMaxDepth> coeffs;
+  int64_t cnst;
+  int64_t array;
+  int64_t slot;  // 0 = pre, 1 = post
+  int64_t share_threshold;  // -1 = thread-private
+  int64_t share_ratio;
+};
+
+struct Nest {
+  int64_t depth;
+  std::array<int64_t, kMaxDepth> trips, starts, steps;
+  // refs grouped per (level, slot), program order preserved
+  std::array<std::vector<Ref>, kMaxDepth> pre, post;
+};
+
+struct State {
+  int64_t thread_num, chunk_size, ds, cls, n_arrays;
+  std::vector<int64_t> count;  // per-tid access clock (runs across nests)
+  // LAT[tid * n_arrays + array]: line -> last access position
+  std::vector<std::unordered_map<int64_t, int64_t>> lat;
+  // noshare_bins[tid * kNoShareSlots + bin]
+  int64_t* noshare_bins;
+  // share[(tid, ratio, raw reuse)] -> count
+  std::map<std::array<int64_t, 3>, int64_t> share;
+};
+
+inline int pow2_bin(int64_t reuse) {
+  // _polybench_to_highest_power_of_two (pluss_utils.h:665-679): the bin
+  // key is 1 << (63 - clz(reuse)); we store the exponent.
+  return 63 - __builtin_clzll(static_cast<uint64_t>(reuse));
+}
+
+inline void access(State& s, int64_t tid, const Ref& r,
+                   const int64_t* ivs) {
+  int64_t flat = r.cnst;
+  for (int64_t l = 0; l <= r.level; ++l) flat += r.coeffs[l] * ivs[l];
+  const int64_t addr = flat * s.ds / s.cls;
+  auto& table = s.lat[tid * s.n_arrays + r.array];
+  auto it = table.find(addr);
+  if (it != table.end()) {
+    const int64_t reuse = s.count[tid] - it->second;
+    bool is_share = false;
+    if (r.share_threshold >= 0) {
+      // distance_to(reuse, 0) > distance_to(reuse, threshold)
+      const int64_t d0 = reuse < 0 ? -reuse : reuse;
+      const int64_t dt = reuse - r.share_threshold < 0
+                             ? r.share_threshold - reuse
+                             : reuse - r.share_threshold;
+      is_share = d0 > dt;
+    }
+    if (is_share) {
+      s.share[{tid, r.share_ratio, reuse}] += 1;
+    } else {
+      s.noshare_bins[tid * kNoShareSlots + pow2_bin(reuse)] += 1;
+    }
+    it->second = s.count[tid];
+  } else {
+    table.emplace(addr, s.count[tid]);
+  }
+  s.count[tid] += 1;
+}
+
+void body(State& s, const Nest& nest, int64_t tid, int64_t level,
+          int64_t* ivs) {
+  for (const Ref& r : nest.pre[level]) access(s, tid, r, ivs);
+  if (level + 1 < nest.depth) {
+    const int64_t trip = nest.trips[level + 1];
+    const int64_t start = nest.starts[level + 1];
+    const int64_t step = nest.steps[level + 1];
+    for (int64_t n = 0; n < trip; ++n) {
+      ivs[level + 1] = start + n * step;
+      body(s, nest, tid, level + 1, ivs);
+    }
+  }
+  for (const Ref& r : nest.post[level]) access(s, tid, r, ivs);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, 1 when share quadruples exceed share_cap (the
+// required count is still written to share_count_out).
+int64_t pluss_run_serial(
+    int64_t thread_num, int64_t chunk_size, int64_t ds, int64_t cls,
+    int64_t n_nests, const int64_t* depths, const int64_t* trips,
+    const int64_t* starts, const int64_t* steps,
+    const int64_t* nest_ref_off, const int64_t* ref_levels,
+    const int64_t* ref_coeffs, const int64_t* ref_consts,
+    const int64_t* ref_arrays, const int64_t* ref_slots,
+    const int64_t* ref_share_thresholds, const int64_t* ref_share_ratios,
+    int64_t n_arrays,
+    int64_t* noshare_bins,  // (thread_num * kNoShareSlots), zeroed here
+    int64_t* share_out,     // (share_cap * 4): tid, ratio, value, count
+    int64_t* share_count_out, int64_t share_cap,
+    int64_t* per_tid_accesses) {
+  State s;
+  s.thread_num = thread_num;
+  s.chunk_size = chunk_size;
+  s.ds = ds;
+  s.cls = cls;
+  s.n_arrays = n_arrays;
+  s.count.assign(thread_num, 0);
+  s.lat.resize(thread_num * n_arrays);
+  s.noshare_bins = noshare_bins;
+  for (int64_t i = 0; i < thread_num * kNoShareSlots; ++i)
+    noshare_bins[i] = 0;
+
+  std::vector<Nest> nests(n_nests);
+  for (int64_t k = 0; k < n_nests; ++k) {
+    Nest& nest = nests[k];
+    nest.depth = depths[k];
+    for (int l = 0; l < kMaxDepth; ++l) {
+      nest.trips[l] = trips[k * kMaxDepth + l];
+      nest.starts[l] = starts[k * kMaxDepth + l];
+      nest.steps[l] = steps[k * kMaxDepth + l];
+    }
+    for (int64_t i = nest_ref_off[k]; i < nest_ref_off[k + 1]; ++i) {
+      Ref r;
+      r.level = ref_levels[i];
+      for (int l = 0; l < kMaxDepth; ++l)
+        r.coeffs[l] = ref_coeffs[i * kMaxDepth + l];
+      r.cnst = ref_consts[i];
+      r.array = ref_arrays[i];
+      r.slot = ref_slots[i];
+      r.share_threshold = ref_share_thresholds[i];
+      r.share_ratio = ref_share_ratios[i];
+      (r.slot == 0 ? nest.pre : nest.post)[r.level].push_back(r);
+    }
+  }
+
+  for (const Nest& nest : nests) {
+    const int64_t trip0 = nest.trips[0];
+    const int64_t n_chunks = (trip0 + chunk_size - 1) / chunk_size;
+    for (int64_t tid = 0; tid < thread_num; ++tid) {
+      // chunks of this thread in static dispatch order
+      // (getNextStaticChunk, pluss_utils.h:410-425)
+      for (int64_t cid = tid; cid < n_chunks; cid += thread_num) {
+        const int64_t lo = cid * chunk_size;
+        const int64_t hi = std::min(lo + chunk_size, trip0);
+        for (int64_t n = lo; n < hi; ++n) {
+          int64_t ivs[kMaxDepth];
+          ivs[0] = nest.starts[0] + n * nest.steps[0];
+          body(s, nest, tid, 0, ivs);
+        }
+      }
+    }
+    // per-nest -1 flush + LAT clear (...ri-omp-seq.cpp:303-319)
+    for (int64_t tid = 0; tid < thread_num; ++tid) {
+      for (int64_t a = 0; a < n_arrays; ++a) {
+        auto& table = s.lat[tid * n_arrays + a];
+        if (!table.empty()) {
+          s.noshare_bins[tid * kNoShareSlots + kColdBin] +=
+              static_cast<int64_t>(table.size());
+          table.clear();
+        }
+      }
+    }
+  }
+
+  *share_count_out = static_cast<int64_t>(s.share.size());
+  int64_t written = 0;
+  for (const auto& kv : s.share) {
+    if (written >= share_cap) break;
+    share_out[written * 4 + 0] = kv.first[0];
+    share_out[written * 4 + 1] = kv.first[1];
+    share_out[written * 4 + 2] = kv.first[2];
+    share_out[written * 4 + 3] = kv.second;
+    ++written;
+  }
+  for (int64_t t = 0; t < thread_num; ++t) per_tid_accesses[t] = s.count[t];
+  return static_cast<int64_t>(s.share.size()) > share_cap ? 1 : 0;
+}
+
+}  // extern "C"

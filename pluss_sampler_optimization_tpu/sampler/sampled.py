@@ -134,6 +134,8 @@ def pad_samples(
     gets at least min_per_dev rows (or exactly total/n_dev when `total`
     is given, to keep one compiled shape across batch chunks)."""
     s = len(samples)
+    if s == 0:
+        raise ValueError("pad_samples needs at least one sample row")
     if total is None:
         per_dev = max(min_per_dev, -(-s // n_dev))
         total = per_dev * n_dev

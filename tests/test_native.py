@@ -1,0 +1,53 @@
+"""Native C++ serial runtime: bit-exact parity with the Python oracle."""
+
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.models.jacobi2d import jacobi2d
+from pluss_sampler_optimization_tpu.models.mm2 import mm2
+from pluss_sampler_optimization_tpu.models.mm3 import mm3
+from pluss_sampler_optimization_tpu.models.syrk import syrk_rect
+from pluss_sampler_optimization_tpu.oracle.serial import run_serial
+
+native = pytest.importorskip("pluss_sampler_optimization_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+MACHINE = MachineConfig()
+
+
+def _results_equal(a, b):
+    assert a.total_accesses == b.total_accesses
+    assert a.per_tid_accesses == b.per_tid_accesses
+    for ha, hb in zip(a.state.noshare, b.state.noshare):
+        assert ha == hb
+    for sa, sb in zip(a.state.share, b.state.share):
+        assert set(sa) == set(sb)
+        for ratio in sa:
+            assert sa[ratio] == sb[ratio]
+
+
+@pytest.mark.parametrize(
+    "prog",
+    [gemm(16), gemm(17), mm2(12), mm3(8), syrk_rect(12),
+     jacobi2d(10, tsteps=2)],
+    ids=lambda p: p.name,
+)
+def test_native_matches_python_oracle(prog):
+    _results_equal(
+        run_serial(prog, MACHINE), native.run_serial_native(prog, MACHINE)
+    )
+
+
+def test_native_odd_machine():
+    m = MachineConfig(thread_num=3, chunk_size=5, ds=4, cls=32)
+    prog = gemm(14)
+    _results_equal(run_serial(prog, m), native.run_serial_native(prog, m))
+
+
+def test_native_share_capacity_error():
+    with pytest.raises(RuntimeError):
+        native.run_serial_native(gemm(24), MACHINE, share_cap=1)
