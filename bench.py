@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""TPU benchmark: sampled engine vs the native serial C++ baseline.
+
+Protocol (BASELINE.md): the reference's "speed" harness times sampler
+wall clock (c_lib/test/Makefile:34-37); its sampled r10 variant is
+measured against the serial full-traversal C++ sampler. Here:
+
+- workload: GEMM N (default 1024), THREAD_NUM=4, CHUNK=4, DS=8, CLS=64
+  — the reference machine model at scale;
+- ours: the vectorized random-start sampled engine (ratio 10%) on the
+  default JAX device (one TPU chip under the driver), timed after a
+  compile warm-up;
+- baseline: the native C++ serial full-traversal sampler
+  (pluss_sampler_optimization_tpu/native), single core, same host —
+  the reference's own accuracy/speed oracle re-implemented over the IR;
+- accuracy: MRC L1 error between the sampled MRC and the serial MRC
+  after the full CRI + AET pipeline on both.
+
+Prints ONE JSON line:
+  {"metric", "value" (samples/s/chip), "unit", "vs_baseline"
+   (serial-seconds / sampled-seconds speedup), "extra" {...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--ratio", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+    from pluss_sampler_optimization_tpu.models.gemm import gemm
+    from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc, mrc_l1_error
+    from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
+    from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+
+    machine = MachineConfig()
+    prog = gemm(args.n)
+    cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
+    dev = jax.devices()[0]
+
+    # warm-up: compiles every per-ref kernel at the run's batch shapes
+    run_sampled(prog, machine, cfg)
+    t0 = time.perf_counter()
+    state, results = run_sampled(prog, machine, cfg)
+    t_tpu = time.perf_counter() - t0
+    total_samples = sum(r.n_samples for r in results)
+
+    extra = {
+        "n": args.n,
+        "ratio": args.ratio,
+        "device": str(dev.platform),
+        "samples": total_samples,
+        "tpu_sampled_s": round(t_tpu, 4),
+    }
+
+    # baseline: native C++ serial full traversal, single core
+    vs_baseline = 0.0
+    try:
+        from pluss_sampler_optimization_tpu import native
+
+        t0 = time.perf_counter()
+        base = native.run_serial_native(prog, machine)
+        t_cpp = time.perf_counter() - t0
+        vs_baseline = t_cpp / t_tpu
+        extra["serial_cpp_s"] = round(t_cpp, 4)
+        extra["serial_accesses"] = base.total_accesses
+
+        T = machine.thread_num
+        mrc_sampled = aet_mrc(cri_distribute(state, T, T), machine)
+        mrc_serial = aet_mrc(cri_distribute(base.state, T, T), machine)
+        extra["mrc_l1_err"] = round(mrc_l1_error(mrc_sampled, mrc_serial), 6)
+    except RuntimeError as e:  # no toolchain: report throughput only
+        extra["baseline_error"] = str(e)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"gemm{args.n}_sampled_throughput",
+                "value": round(total_samples / t_tpu, 1),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(vs_baseline, 2),
+                "extra": extra,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

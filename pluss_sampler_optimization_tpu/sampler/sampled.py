@@ -73,22 +73,28 @@ def draw_samples(
     ]
     s = cfg.num_samples(tuple(trips))
     rng = np.random.default_rng(seed)
-    seen: set[int] = set()
-    out = []
-    while len(out) < s:
-        batch = np.stack(
-            [rng.integers(0, h, size=max(64, s)) for h in highs], axis=1
-        )
-        key = batch[:, 0]
-        for col in range(1, batch.shape[1]):
-            key = key * highs[col] + batch[:, col]
-        for row, k in zip(batch, key.tolist()):
-            if k not in seen:
-                seen.add(k)
-                out.append(row)
-                if len(out) == s:
-                    break
-    return np.array(out, dtype=np.int64)
+    # Vectorized draw-until-s-unique: dedupe preserves first occurrence
+    # in draw order (truncation of the draw-ordered stream keeps the
+    # distribution identical to the reference's one-at-a-time redraw
+    # loop's sample *set* semantics, r10 :159-185).
+    out_keys = np.empty(0, dtype=np.int64)
+    while len(out_keys) < s:
+        need = s - len(out_keys)
+        batch_keys = rng.integers(0, highs[0], size=max(64, need + need // 8))
+        for h in highs[1:]:
+            batch_keys = batch_keys * h + rng.integers(
+                0, h, size=batch_keys.shape
+            )
+        _, first_idx = np.unique(batch_keys, return_index=True)
+        fresh = batch_keys[np.sort(first_idx)]
+        if len(out_keys):
+            fresh = fresh[~np.isin(fresh, out_keys)]
+        out_keys = np.concatenate([out_keys, fresh])[:s]
+    cols = []
+    for h in reversed(highs):
+        out_keys, col = np.divmod(out_keys, h)
+        cols.append(col)
+    return np.stack(cols[::-1], axis=1).astype(np.int64)
 
 
 def check_packed_ratios(nt: NestTrace) -> None:
