@@ -1,0 +1,174 @@
+"""Command-line driver — the run.sh / Makefile / main() layer (L4).
+
+The reference drives everything through `sh run.sh {acc|speed}` and
+`make {acc|speed|sample}` (run.sh:3-12, c_lib/test/Makefile:34-44),
+with per-binary main()s selecting the mode
+(...ri-omp.cpp:334-360, src/main.rs:17-44). One CLI replaces them:
+
+  python -m pluss_sampler_optimization_tpu acc    --model gemm --n 128
+  python -m pluss_sampler_optimization_tpu speed  --engine dense --reps 10
+  python -m pluss_sampler_optimization_tpu sample --ratio 0.1 --mrc-out f
+
+- `acc`: one run, then the reference's accuracy dumps — noshare/share
+  private-reuse histograms, the distributed RI histogram, the MRC, and
+  the max-iteration count (...ri-omp-seq.cpp:334-362). Engines are
+  interchangeable so dumps can be diffed across implementations exactly
+  like the reference's output.txt protocol (README.md:10-12).
+- `speed`: N timed repetitions (Makefile:34-37 runs 10).
+- `sample`: the sampled r10-equivalent path with per-ref dumps and the
+  merged histogram + MRC (...rs-ri-opt-r10.cpp:3277-3293).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _build_model(name: str, n: int, tsteps: int):
+    from .models.gemm import gemm
+    from .models.jacobi2d import jacobi2d
+    from .models.mm2 import mm2
+    from .models.mm3 import mm3
+    from .models.syrk import syrk_rect
+
+    if name == "gemm":
+        return gemm(n)
+    if name == "2mm":
+        return mm2(n)
+    if name == "3mm":
+        return mm3(n)
+    if name == "syrk":
+        return syrk_rect(n)
+    if name == "jacobi-2d":
+        return jacobi2d(n, tsteps=tsteps)
+    raise SystemExit(f"unknown model {name!r}")
+
+
+def _run_engine(engine: str, program, machine, args):
+    """One run -> (OracleResult-like, per-ref sampled results or None)."""
+    if engine == "oracle":
+        from .oracle.serial import run_serial
+
+        return run_serial(program, machine), None
+    if engine == "numpy":
+        from .oracle.numpy_ref import run_numpy
+
+        return run_numpy(program, machine), None
+    if engine == "native":
+        from . import native
+
+        return native.run_serial_native(program, machine), None
+    if engine == "dense":
+        from .sampler.dense import run_dense
+
+        return run_dense(program, machine), None
+    if engine in ("sampled", "sharded"):
+        from .config import SamplerConfig
+
+        cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
+        if engine == "sampled":
+            from .sampler.sampled import run_sampled
+
+            state, results = run_sampled(program, machine, cfg)
+        else:
+            from .parallel import build_mesh, run_sampled_sharded
+
+            state, results = run_sampled_sharded(
+                program, machine, cfg, build_mesh()
+            )
+
+        import types
+
+        # sampled engines track samples, not accesses
+        res = types.SimpleNamespace(
+            state=state,
+            total_accesses=sum(r.n_samples for r in results),
+        )
+        return res, results
+    raise SystemExit(f"unknown engine {engine!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
+    ap.add_argument("mode", choices=["acc", "speed", "sample"])
+    ap.add_argument("--model", default="gemm",
+                    help="gemm | 2mm | 3mm | syrk | jacobi-2d")
+    ap.add_argument("--n", type=int, default=128)
+    ap.add_argument("--tsteps", type=int, default=1, help="jacobi-2d only")
+    ap.add_argument(
+        "--engine",
+        default=None,
+        help="oracle | numpy | native | dense | sampled | sharded "
+        "(default: dense; sample mode forces sampled)",
+    )
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--ratio", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--mrc-out", default=None,
+                    help="also write the MRC to this file")
+    ap.add_argument(
+        "--platform",
+        default=None,
+        help="JAX platform override (e.g. cpu). Must be applied before "
+        "any backend initializes; plain env vars are too late when a "
+        "site pins a TPU plugin (see tests/conftest.py).",
+    )
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from .config import MachineConfig
+    from .runtime import report
+    from .runtime.aet import aet_mrc
+    from .runtime.cri import cri_distribute
+
+    machine = MachineConfig(thread_num=args.threads, chunk_size=args.chunk)
+    program = _build_model(args.model, args.n, args.tsteps)
+    engine = args.engine or ("sampled" if args.mode == "sample" else "dense")
+    if args.mode == "sample" and engine not in ("sampled", "sharded"):
+        raise SystemExit("sample mode needs --engine sampled|sharded")
+
+    if args.mode == "speed":
+        # Makefile:34-37 / main.rs:31-33: repeated timed runs.
+        times = []
+        for rep in range(args.reps):
+            t0 = time.perf_counter()
+            _run_engine(engine, program, machine, args)
+            dt = time.perf_counter() - t0
+            times.append(dt)
+            print(f"{engine} {program.name} run {rep}: {dt:.6f} s")
+        print(
+            f"{engine} {program.name}: best {min(times):.6f} s, "
+            f"mean {sum(times) / len(times):.6f} s over {len(times)} runs"
+        )
+        return 0
+
+    res, per_ref = _run_engine(engine, program, machine, args)
+
+    if args.mode == "sample" and per_ref is not None:
+        # per-ref dumps (r10 prints each per-ref histogram, :3277-3293)
+        for r in per_ref:
+            print(f"ref {r.name}: {r.n_samples} samples, cold {r.cold:g}")
+
+    report.emit(report.noshare_dump(res.state))
+    report.emit(report.share_dump(res.state))
+    rih = cri_distribute(res.state, machine.thread_num, machine.thread_num)
+    report.emit(report.rih_dump(rih))
+    mrc = aet_mrc(rih, machine)
+    report.emit(report.mrc_lines(mrc))
+    label = "samples" if per_ref is not None else "accesses"
+    print(f"max iteration count: {res.total_accesses} {label}")
+    if args.mrc_out:
+        report.write_mrc_to_file(mrc, args.mrc_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

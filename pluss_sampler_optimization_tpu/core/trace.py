@@ -103,20 +103,24 @@ class NestTrace:
         level = int(self.tables.ref_levels[ref_idx])
         return tuple(lp.trip for lp in self.nest.loops[: level + 1])
 
-    def enumerate_ref(self, tid: int, ref_idx: int):
+    def enumerate_ref(self, tid: int, ref_idx: int, schedule=None):
         """All accesses of (tid, ref): returns (positions, addrs) int64.
 
         Vectorized numpy enumeration; the concatenation over refs is the
         thread's complete access stream (in arbitrary order — the
-        position array carries the ordering).
+        position array carries the ordering). `schedule` overrides the
+        nest's round-robin static schedule (any object with
+        local_count/local_to_value; the executing profiler passes its
+        contiguous row-block split, oracle/profiler.py).
         """
+        sched = schedule if schedule is not None else self.schedule
         level = int(self.tables.ref_levels[ref_idx])
-        L = self.schedule.local_count(tid)
+        L = sched.local_count(tid)
         if L == 0:
             z = np.zeros(0, dtype=np.int64)
             return z, z.copy()
         m = np.arange(L, dtype=np.int64)
-        v0 = self.schedule.local_to_value(tid, m)
+        v0 = sched.local_to_value(tid, m)
         if level == 0:
             pos = self.access_position(ref_idx, m)
             addr = self.ref_addr(ref_idx, v0)

@@ -1,0 +1,70 @@
+"""CLI driver: the reference's acc/speed/sample harness as one command.
+
+The reference's accuracy protocol is "run each implementation, append
+the dumps to output.txt, diff" (README.md:10-12, Makefile:39-41);
+test_acc_dumps_identical_across_engines automates exactly that diff.
+"""
+
+import pytest
+
+from pluss_sampler_optimization_tpu.cli import main
+
+
+def _dump(capsys, argv):
+    assert main(argv) == 0
+    return capsys.readouterr().out
+
+
+def test_acc_dumps_identical_across_engines(capsys):
+    outs = {}
+    engines = ["oracle", "numpy", "dense"]
+    try:
+        from pluss_sampler_optimization_tpu import native
+
+        if native.available():
+            engines.append("native")
+    except Exception:
+        pass
+    for engine in engines:
+        outs[engine] = _dump(
+            capsys, ["acc", "--model", "gemm", "--n", "16", "--engine", engine]
+        )
+    base = outs["oracle"]
+    for engine, out in outs.items():
+        assert out == base, f"{engine} dumps differ from oracle"
+
+
+def test_speed_mode(capsys):
+    out = _dump(
+        capsys,
+        ["speed", "--model", "gemm", "--n", "16", "--engine", "oracle",
+         "--reps", "2"],
+    )
+    assert "run 0" in out and "run 1" in out and "best" in out
+
+
+def test_sample_mode_writes_mrc(tmp_path, capsys):
+    path = tmp_path / "mrc.txt"
+    out = _dump(
+        capsys,
+        ["sample", "--model", "gemm", "--n", "16", "--ratio", "0.3",
+         "--mrc-out", str(path)],
+    )
+    assert "ref B0" in out and "samples" in out
+    lines = path.read_text().splitlines()
+    assert lines[0] == "miss ratio"
+    assert lines[1].startswith("0, 1")
+
+
+def test_all_models_build(capsys):
+    for model in ["gemm", "2mm", "3mm", "syrk", "jacobi-2d"]:
+        out = _dump(
+            capsys,
+            ["acc", "--model", model, "--n", "8", "--engine", "oracle"],
+        )
+        assert "miss ratio" in out
+
+
+def test_unknown_engine():
+    with pytest.raises(SystemExit):
+        main(["acc", "--engine", "bogus"])

@@ -1,0 +1,88 @@
+"""Ground-truth profiler: real execution + exact RI accounting."""
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.models.mm2 import mm2
+from pluss_sampler_optimization_tpu.oracle.profiler import (
+    ContiguousSchedule,
+    execute_gemm,
+    gemm_init,
+    profile_gemm,
+    profile_program,
+)
+from pluss_sampler_optimization_tpu.oracle.serial import run_serial
+from pluss_sampler_optimization_tpu.runtime.hist import pow2_floor
+
+
+def _binned(h):
+    """pow2-bin a raw histogram, keeping -1; drop zero counts."""
+    out = {}
+    for k, v in h.items():
+        key = pow2_floor(int(k)) if k > 0 else int(k)
+        out[key] = out.get(key, 0.0) + v
+    return {k: v for k, v in out.items() if v}
+
+
+def _oracle_binned(state, tid):
+    """Oracle noshare (already binned) + share (raw) as one binned hist."""
+    h = dict(state.noshare[tid])
+    for ratio_h in state.share[tid].values():
+        for k, v in ratio_h.items():
+            key = pow2_floor(int(k)) if k > 0 else int(k)
+            h[key] = h.get(key, 0.0) + v
+    return {k: v for k, v in h.items() if v}
+
+
+def test_execute_gemm_matches_closed_form():
+    C0, A, B = gemm_init(12, 12, 12)
+    out = execute_gemm(12, 12, 12, thread_num=4)
+    np.testing.assert_allclose(out, 1.2 * C0 + 1.5 * A @ B, rtol=1e-12)
+
+
+def test_contiguous_schedule_uneven_split():
+    s = ContiguousSchedule(trip=10, threads=4)
+    counts = [s.local_count(t) for t in range(4)]
+    assert counts == [3, 3, 2, 2]
+    vals = [s.local_to_value(t, m) for t in range(4) for m in range(counts[t])]
+    assert vals == list(range(10))
+
+
+def test_profiler_single_thread_matches_oracle():
+    machine = MachineConfig(thread_num=1)
+    prog = gemm(16)
+    prof = profile_program(prog, machine)
+    oracle = run_serial(prog, machine)
+    assert prof.per_tid_accesses == oracle.per_tid_accesses
+    assert _binned(prof.hists[0]) == _oracle_binned(oracle.state, 0)
+
+
+def test_profiler_multinest_single_thread():
+    machine = MachineConfig(thread_num=1)
+    prog = mm2(8)
+    prof = profile_program(prog, machine)
+    oracle = run_serial(prog, machine)
+    assert _binned(prof.hists[0]) == _oracle_binned(oracle.state, 0)
+
+
+def test_profiler_matches_oracle_when_schedules_coincide():
+    """Round-robin with n_chunks == threads IS the contiguous split."""
+    n, t = 16, 4
+    machine = MachineConfig(thread_num=t, chunk_size=n // t)
+    prog = gemm(n)
+    prof = profile_program(prog, machine)
+    oracle = run_serial(prog, machine)
+    assert prof.per_tid_accesses == oracle.per_tid_accesses
+    for tid in range(t):
+        assert _binned(prof.hists[tid]) == _oracle_binned(oracle.state, tid)
+
+
+def test_profile_gemm_entry():
+    res = profile_gemm(8)
+    assert res.output is not None
+    assert len(res.hists) == 4
+    assert sum(res.per_tid_accesses) == 8 * 8 * (2 + 4 * 8)
+    merged = res.merged()
+    assert merged[-1] > 0  # cold first touches recorded as -1
