@@ -51,7 +51,7 @@ def _run_engine(engine: str, program, machine, args):
     if engine == "oracle":
         from .oracle.serial import run_serial
 
-        return run_serial(program, machine), None
+        return run_serial(program, machine, v2=args.runtime == "v2"), None
     if engine == "numpy":
         from .oracle.numpy_ref import run_numpy
 
@@ -68,15 +68,16 @@ def _run_engine(engine: str, program, machine, args):
         from .config import SamplerConfig
 
         cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
+        v2 = args.runtime == "v2"
         if engine == "sampled":
             from .sampler.sampled import run_sampled
 
-            state, results = run_sampled(program, machine, cfg)
+            state, results = run_sampled(program, machine, cfg, v2=v2)
         else:
             from .parallel import build_mesh, run_sampled_sharded
 
             state, results = run_sampled_sharded(
-                program, machine, cfg, build_mesh()
+                program, machine, cfg, build_mesh(), v2=v2
             )
 
         import types
@@ -110,6 +111,21 @@ def main(argv=None) -> int:
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--mrc-out", default=None,
                     help="also write the MRC to this file")
+    ap.add_argument(
+        "--runtime",
+        choices=["v1", "v2"],
+        default="v1",
+        help="histogram runtime semantics: v1 pow2-bins noshare on "
+        "insertion (pluss_utils.h:924-927), v2 keeps raw keys "
+        "(pluss_utils_v2.h:915-918). oracle/sampled/sharded engines.",
+    )
+    ap.add_argument(
+        "--r10",
+        action="store_true",
+        help="sample mode: distribute with the r10 generated-code quirk "
+        "copies per reference (...rs-ri-opt-r10.cpp:42-131) instead of "
+        "the runtime-v1 CRI model",
+    )
     ap.add_argument(
         "--platform",
         default=None,
@@ -159,7 +175,18 @@ def main(argv=None) -> int:
 
     report.emit(report.noshare_dump(res.state))
     report.emit(report.share_dump(res.state))
-    rih = cri_distribute(res.state, machine.thread_num, machine.thread_num)
+    if args.r10:
+        if per_ref is None:
+            raise SystemExit("--r10 needs a sampled engine (sample mode)")
+        from .runtime.cri import r10_distribute
+
+        rih, per_ref_hists = r10_distribute(per_ref, machine.thread_num)
+        for name, h in per_ref_hists.items():
+            report.emit(report.histogram_lines(name, h))
+    else:
+        rih = cri_distribute(
+            res.state, machine.thread_num, machine.thread_num
+        )
     report.emit(report.rih_dump(rih))
     mrc = aet_mrc(rih, machine)
     report.emit(report.mrc_lines(mrc))

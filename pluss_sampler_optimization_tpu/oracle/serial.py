@@ -43,11 +43,15 @@ class OracleResult:
     per_tid_accesses: list
 
 
-def run_serial(program: Program, machine: MachineConfig) -> OracleResult:
+def run_serial(
+    program: Program, machine: MachineConfig, v2: bool = False
+) -> OracleResult:
+    """v2=True selects the runtime-v2 histogram semantics (raw noshare
+    keys, pluss_utils_v2.h:915-918)."""
     from ..core.schedule import StaticSchedule
 
     P = machine.thread_num
-    state = PRIState(P)
+    state = PRIState(P, bin_noshare=not v2)
     lat: dict[tuple[int, str], dict[int, int]] = {
         (t, a): {} for t in range(P) for a in program.arrays
     }

@@ -152,13 +152,14 @@ def run_sampled_sharded(
     machine: MachineConfig,
     cfg: SamplerConfig | None = None,
     mesh: jax.sharding.Mesh | None = None,
+    v2: bool = False,
     **kw,
 ) -> tuple[PRIState, list[SampledRefResult]]:
     """Sharded engine -> PRIState; bit-identical to sampler/sampled.py's
     run_sampled on any mesh size (same draw, exact merges)."""
     cfg = cfg or SamplerConfig()
     results, _ = sampled_outputs_sharded(program, machine, cfg, mesh, **kw)
-    return fold_results(results, machine.thread_num), results
+    return fold_results(results, machine.thread_num, v2), results
 
 
 def run_dense_sharded(

@@ -192,3 +192,37 @@ def cri_distribute(
     noshare_distribute(state.merged_noshare(), rih, thread_cnt, thread_num)
     racetrack(state.merged_share(), rih, thread_cnt, thread_num)
     return rih
+
+
+def r10_distribute(
+    results, thread_num: int, quirks: Optional[R10Quirks] = None
+) -> tuple[Hist, dict]:
+    """The r10 main flow: per-reference local distributes with the r10
+    quirk copies, raw-keyed (no_share_distribute + share_distribute into
+    each per-ref histogram, ...rs-ri-opt-r10.cpp:666-693, 42-131), then
+    a pow2-binned merge of the per-ref histograms into the global RI
+    histogram (pluss_histogram_update default in_log_format,
+    :3258-3276). Returns (merged RIHist, {ref name: per-ref Hist}).
+
+    `results` are SampledRefResult (sampler/sampled.py): raw noshare
+    and share values with the cold (-1) multiplicity, exactly what the
+    per-ref samplers hold at their END_SAMPLE block (:666-693).
+    """
+    quirks = quirks if quirks is not None else R10Quirks()
+    per_ref: dict = {}
+    merged: Hist = {}
+    for r in results:
+        rih: Hist = {}
+        nosh = dict(r.noshare)
+        if r.cold:
+            nosh[-1] = nosh.get(-1, 0.0) + r.cold
+        noshare_distribute(
+            nosh, rih, thread_num, thread_num, quirks, in_log_format=False
+        )
+        racetrack(
+            r.share, rih, thread_num, thread_num, quirks, in_log_format=False
+        )
+        per_ref[r.name] = rih
+        for k, v in rih.items():
+            hist_update(merged, int(k), v, in_log_format=True)
+    return merged, per_ref

@@ -52,11 +52,17 @@ class PRIState:
     share[tid]: {share_ratio: Hist with *raw* reuse keys} — the share
     update deliberately skips binning (pluss_utils.h:928-937) because the
     racetrack model needs raw interval lengths (pluss_utils.h:1060-1097).
+
+    bin_noshare=False selects the runtime-v2 semantics: v2's noshare
+    update drops the pow2 binning on insertion (`false` argument,
+    pluss_utils_v2.h:915-918 vs v1 pluss_utils.h:924-927), keeping raw
+    reuse keys everywhere.
     """
 
     thread_num: int
     noshare: list = dataclasses.field(default_factory=list)
     share: list = dataclasses.field(default_factory=list)
+    bin_noshare: bool = True
 
     def __post_init__(self) -> None:
         if not self.noshare:
@@ -65,8 +71,11 @@ class PRIState:
             self.share = [dict() for _ in range(self.thread_num)]
 
     def update_noshare(self, tid: int, reuse: int, cnt: float) -> None:
-        """pluss_cri_noshare_histogram_update (pluss_utils.h:924-927)."""
-        hist_update(self.noshare[tid], reuse, cnt, in_log_format=True)
+        """pluss_cri_noshare_histogram_update (pluss_utils.h:924-927;
+        v2: pluss_utils_v2.h:915-918 via bin_noshare=False)."""
+        hist_update(
+            self.noshare[tid], reuse, cnt, in_log_format=self.bin_noshare
+        )
 
     def update_share(self, tid: int, ratio: int, reuse: int, cnt: float) -> None:
         """pluss_cri_share_histogram_update (pluss_utils.h:928-937)."""

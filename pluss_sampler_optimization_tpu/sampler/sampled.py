@@ -304,16 +304,17 @@ def sampled_outputs(
 
 
 def fold_results(
-    results: list[SampledRefResult], thread_num: int
+    results: list[SampledRefResult], thread_num: int, v2: bool = False
 ) -> PRIState:
     """Per-ref sampled results -> PRIState in runtime-v1 form (noshare
     pow2-binned on insertion, share raw), all counts attributed to
     simulated thread 0 — the distribute/print stages only ever consume
     thread-merged histograms (pluss_utils.h:1013-1022, :1042-1058), and
-    the r10 variant likewise keeps per-ref (not per-thread) histograms."""
+    the r10 variant likewise keeps per-ref (not per-thread) histograms.
+    v2=True keeps noshare keys raw (pluss_utils_v2.h:915-918)."""
     from ..runtime.hist import hist_update
 
-    state = PRIState(thread_num)
+    state = PRIState(thread_num, bin_noshare=not v2)
     for r in results:
         for ri_val, cnt in r.noshare.items():
             state.update_noshare(0, ri_val, cnt)
@@ -329,9 +330,10 @@ def run_sampled(
     program: Program,
     machine: MachineConfig,
     cfg: SamplerConfig | None = None,
+    v2: bool = False,
     **kw,
 ) -> tuple[PRIState, list[SampledRefResult]]:
     """Sampled engine -> PRIState (see fold_results for the v1 form)."""
     cfg = cfg or SamplerConfig()
     results = sampled_outputs(program, machine, cfg, **kw)
-    return fold_results(results, machine.thread_num), results
+    return fold_results(results, machine.thread_num, v2), results

@@ -1,0 +1,99 @@
+"""r10 per-ref distribute path and runtime-v2 histogram semantics."""
+
+import math
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.oracle.serial import run_serial
+from pluss_sampler_optimization_tpu.runtime.cri import r10_distribute
+from pluss_sampler_optimization_tpu.sampler.sampled import (
+    fold_results,
+    run_sampled,
+    sampled_outputs,
+)
+
+MACHINE = MachineConfig()
+CFG = SamplerConfig(ratio=0.25, seed=2)
+
+
+def _is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def test_r10_distribute_merged_keys_and_mass():
+    results = sampled_outputs(gemm(16, share_threshold_variant="r10"),
+                              MACHINE, CFG)
+    merged, per_ref = r10_distribute(results, MACHINE.thread_num)
+    assert set(per_ref) == {"C0", "C1", "A0", "B0", "C2", "C3"}
+    # merge pow2-bins on insertion (pluss_histogram_update default)
+    for k in merged:
+        assert k == -1 or _is_pow2(k)
+    # mass conservation: NBD truncates at prob_sum > 0.999 (r10 :60),
+    # racetrack folds its remainder exactly
+    mass_in = sum(
+        sum(r.noshare.values())
+        + r.cold
+        + sum(sum(h.values()) for h in r.share.values())
+        for r in results
+    )
+    mass_out = sum(merged.values())
+    assert mass_in > 0
+    assert math.isclose(mass_out, mass_in, rel_tol=0.01)
+
+
+def test_r10_share_point_mass():
+    """r10's share path degenerates to a point mass at
+    THREAD_NUM * pow2_floor(ri) before the racetrack split
+    (...rs-ri-opt-r10.cpp:94 passing 1.0/THREAD_NUM as int)."""
+    results = sampled_outputs(gemm(16, share_threshold_variant="r10"),
+                              MACHINE, CFG)
+    b0 = next(r for r in results if r.name == "B0")
+    if not any(b0.share.values()):
+        return  # no share reuse sampled at this tiny size
+    _, per_ref = r10_distribute(results, MACHINE.thread_num)
+    # racetrack output keys are powers of two (2^(b-1)); none may exceed
+    # the point mass THREAD_NUM * pow2_floor(max ri)
+    max_ri = max(k for h in b0.share.values() for k in h)
+    bound = MACHINE.thread_num * (1 << (max_ri.bit_length() - 1))
+    share_keys = [k for k in per_ref["B0"] if k > 0]
+    assert all(k <= bound for k in share_keys)
+
+
+def test_v2_oracle_raw_noshare_keys():
+    prog = gemm(16)
+    v1 = run_serial(prog, MACHINE)
+    v2 = run_serial(prog, MACHINE, v2=True)
+    assert v1.total_accesses == v2.total_accesses
+    for tid in range(MACHINE.thread_num):
+        assert sum(v1.state.noshare[tid].values()) == sum(
+            v2.state.noshare[tid].values()
+        )
+    # v2 keeps raw keys: GEMM has reuses that are not powers of two
+    raw_keys = {k for h in v2.state.noshare for k in h if k > 0}
+    assert any(not _is_pow2(k) for k in raw_keys)
+    # share side identical (share was never binned in either runtime)
+    for a, b in zip(v1.state.share, v2.state.share):
+        assert a == b
+
+
+def test_v2_fold_matches_raw_pairs():
+    _, results = run_sampled(gemm(16), MACHINE, CFG)
+    state = fold_results(results, MACHINE.thread_num, v2=True)
+    raw = {}
+    for r in results:
+        for k, v in r.noshare.items():
+            raw[k] = raw.get(k, 0.0) + v
+    folded = {k: v for k, v in state.noshare[0].items() if k > 0}
+    assert folded == raw
+
+
+def test_cli_r10_and_v2(capsys):
+    assert main(["sample", "--model", "gemm", "--n", "16", "--ratio",
+                 "0.3", "--r10"]) == 0
+    out = capsys.readouterr().out
+    assert "B0" in out and "miss ratio" in out
+    assert main(["acc", "--model", "gemm", "--n", "16", "--engine",
+                 "oracle", "--runtime", "v2"]) == 0
+    out = capsys.readouterr().out
+    assert "miss ratio" in out
