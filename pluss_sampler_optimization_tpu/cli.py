@@ -64,6 +64,10 @@ def _run_engine(engine: str, program, machine, args):
         from .sampler.dense import run_dense
 
         return run_dense(program, machine), None
+    if engine == "stream":
+        from .sampler.stream import run_stream
+
+        return run_stream(program, machine), None
     if engine in ("sampled", "sharded"):
         from .config import SamplerConfig
 
@@ -101,8 +105,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--engine",
         default=None,
-        help="oracle | numpy | native | dense | sampled | sharded "
-        "(default: dense; sample mode forces sampled)",
+        help="oracle | numpy | native | dense | stream | sampled | "
+        "sharded (default: dense; sample mode forces sampled)",
     )
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--chunk", type=int, default=4)

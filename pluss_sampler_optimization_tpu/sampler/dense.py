@@ -45,19 +45,17 @@ def _ceil_log2(x: int) -> int:
     return max(1, int(x - 1).bit_length())
 
 
-def _nest_device_arrays(nt: NestTrace, max_share_values: int):
-    """Build the jitted per-nest kernel: tid -> dense histogram outputs."""
+def nest_geometry(nt: NestTrace):
+    """(n_arrays, max_addr, n_groups) for the packed-key group space.
+
+    Validates the packing preconditions: negative flats would corrupt
+    the packed sort keys, and share ratios must fit the radix-8 share
+    key. Shared by the one-shot (this module) and streaming
+    (sampler/stream.py) dense engines.
+    """
     t = nt.tables
-    sched = nt.schedule
     machine = nt.machine
-    lmax = sched.max_local_count()
     n_arrays = int(t.ref_arrays.max()) + 1 if t.n_refs else 1
-    # static per-tid local counts (device-selectable by tid)
-    local_counts = jnp.array(
-        [sched.local_count(tt) for tt in range(sched.threads)], dtype=jnp.int64
-    )
-    # address bounds over the nest (for key packing); negative flats
-    # would corrupt the packed sort keys, so reject them loudly
     max_addr = 1
     for ri in range(t.n_refs):
         level = int(t.ref_levels[ri])
@@ -81,7 +79,87 @@ def _nest_device_arrays(nt: NestTrace, max_share_values: int):
                 "packed share key (radix 8)"
             )
         max_addr = max(max_addr, hi * machine.ds // machine.cls + 1)
-    n_groups = n_arrays * max_addr + 1  # +1 invalid group
+    return n_arrays, max_addr, n_arrays * max_addr + 1  # +1 invalid group
+
+
+def packed_ref_keys(
+    nt: NestTrace, ri: int, v0, mrel, valid_m, pos_bits: int,
+    max_addr: int, n_groups: int,
+):
+    """Packed (group, position, ref) sort keys of one ref's accesses
+    over an m-grid.
+
+    `v0` are the parallel-loop values, `mrel` the position-relative
+    parallel indices (equal to the thread-local m for the one-shot
+    engine, chunk-relative for the streaming engine), `valid_m` the
+    raggedness mask. Invalid entries land in group n_groups-1.
+    """
+    t = nt.tables
+    machine = nt.machine
+    level = int(t.ref_levels[ri])
+    c = t.ref_coeffs[ri]
+    off = int(t.ref_offsets[ri])
+    a0 = int(t.acc_per_level[0])
+    if level == 0:
+        pos = mrel * a0 + off
+        flat = v0 * int(c[0]) + int(t.ref_consts[ri])
+        valid = valid_m
+    elif level == 1:
+        t1 = nt.nest.loops[1]
+        n1 = jnp.arange(t1.trip, dtype=jnp.int64)
+        v1 = t1.start + n1 * t1.step
+        pos = (
+            mrel[:, None] * a0
+            + nt.npre[0]
+            + n1[None, :] * int(t.acc_per_level[1])
+            + off
+        )
+        flat = (
+            v0[:, None] * int(c[0])
+            + v1[None, :] * int(c[1])
+            + int(t.ref_consts[ri])
+        )
+        valid = jnp.broadcast_to(valid_m[:, None], pos.shape)
+    else:
+        t1, t2 = nt.nest.loops[1], nt.nest.loops[2]
+        n1 = jnp.arange(t1.trip, dtype=jnp.int64)
+        n2 = jnp.arange(t2.trip, dtype=jnp.int64)
+        v1 = t1.start + n1 * t1.step
+        v2 = t2.start + n2 * t2.step
+        pos = (
+            mrel[:, None, None] * a0
+            + nt.npre[0]
+            + n1[None, :, None] * int(t.acc_per_level[1])
+            + nt.npre[1]
+            + n2[None, None, :] * int(t.acc_per_level[2])
+            + off
+        )
+        flat = (
+            v0[:, None, None] * int(c[0])
+            + v1[None, :, None] * int(c[1])
+            + v2[None, None, :] * int(c[2])
+            + int(t.ref_consts[ri])
+        )
+        valid = jnp.broadcast_to(valid_m[:, None, None], pos.shape)
+    addr = flat * machine.ds // machine.cls
+    grp = jnp.where(
+        valid, int(t.ref_arrays[ri]) * max_addr + addr, n_groups - 1
+    )
+    key = (((grp << pos_bits) | pos.astype(jnp.int64)) << _REF_BITS) | ri
+    return key.ravel()
+
+
+def _nest_device_arrays(nt: NestTrace, max_share_values: int):
+    """Build the jitted per-nest kernel: tid -> dense histogram outputs."""
+    t = nt.tables
+    sched = nt.schedule
+    machine = nt.machine
+    lmax = sched.max_local_count()
+    # static per-tid local counts (device-selectable by tid)
+    local_counts = jnp.array(
+        [sched.local_count(tt) for tt in range(sched.threads)], dtype=jnp.int64
+    )
+    n_arrays, max_addr, n_groups = nest_geometry(nt)
     pos_bits = _ceil_log2(lmax * int(t.acc_per_level[0]) + 1)
     grp_bits = _ceil_log2(n_groups + 1)
     assert grp_bits + pos_bits + _REF_BITS <= 63, "key packing overflow"
@@ -95,65 +173,15 @@ def _nest_device_arrays(nt: NestTrace, max_share_values: int):
         # them (and everything downstream) out of XLA's compile-time
         # constant folder — with no runtime inputs the whole sampler
         # would be folded into a literal at compile time.
-        keys = []
-        for ri in range(t.n_refs):
-            level = int(t.ref_levels[ri])
-            m = jnp.arange(lmax, dtype=jnp.int64) + zero
-            valid_m = m < local_counts[tid]
-            v0 = ((m // K) * P + tid) * K + (m % K)
-            v0 = start0 + v0 * step0
-            c = t.ref_coeffs[ri]
-            off = int(t.ref_offsets[ri])
-            a0 = int(t.acc_per_level[0])
-            if level == 0:
-                pos = m * a0 + off
-                flat = v0 * int(c[0]) + int(t.ref_consts[ri])
-                valid = valid_m
-            elif level == 1:
-                t1 = nt.nest.loops[1]
-                n1 = jnp.arange(t1.trip, dtype=jnp.int64)
-                v1 = t1.start + n1 * t1.step
-                pos = (
-                    m[:, None] * a0
-                    + nt.npre[0]
-                    + n1[None, :] * int(t.acc_per_level[1])
-                    + off
-                )
-                flat = (
-                    v0[:, None] * int(c[0])
-                    + v1[None, :] * int(c[1])
-                    + int(t.ref_consts[ri])
-                )
-                valid = jnp.broadcast_to(valid_m[:, None], pos.shape)
-            else:
-                t1, t2 = nt.nest.loops[1], nt.nest.loops[2]
-                n1 = jnp.arange(t1.trip, dtype=jnp.int64)
-                n2 = jnp.arange(t2.trip, dtype=jnp.int64)
-                v1 = t1.start + n1 * t1.step
-                v2 = t2.start + n2 * t2.step
-                pos = (
-                    m[:, None, None] * a0
-                    + nt.npre[0]
-                    + n1[None, :, None] * int(t.acc_per_level[1])
-                    + nt.npre[1]
-                    + n2[None, None, :] * int(t.acc_per_level[2])
-                    + off
-                )
-                flat = (
-                    v0[:, None, None] * int(c[0])
-                    + v1[None, :, None] * int(c[1])
-                    + v2[None, None, :] * int(c[2])
-                    + int(t.ref_consts[ri])
-                )
-                valid = jnp.broadcast_to(valid_m[:, None, None], pos.shape)
-            addr = flat * machine.ds // machine.cls
-            grp = jnp.where(
-                valid, int(t.ref_arrays[ri]) * max_addr + addr, n_groups - 1
+        m = jnp.arange(lmax, dtype=jnp.int64) + zero
+        valid_m = m < local_counts[tid]
+        v0 = start0 + (((m // K) * P + tid) * K + (m % K)) * step0
+        keys = [
+            packed_ref_keys(
+                nt, ri, v0, m, valid_m, pos_bits, max_addr, n_groups
             )
-            key = (
-                ((grp << pos_bits) | pos.astype(jnp.int64)) << _REF_BITS
-            ) | ri
-            keys.append(key.ravel())
+            for ri in range(t.n_refs)
+        ]
         key = jnp.sort(jnp.concatenate(keys))
         ref_s = (key & ((1 << _REF_BITS) - 1)).astype(jnp.int32)
         pos_s = (key >> _REF_BITS) & ((1 << pos_bits) - 1)

@@ -1,0 +1,206 @@
+"""Streaming dense engine: exact full traversal at large N.
+
+sampler/dense.py materializes each simulated thread's whole access
+stream for one sort — at GEMM N=4096 that is ~7e10 accesses per
+thread, far beyond HBM. This engine streams the same computation over
+chunks of the parallel loop with `lax.scan`:
+
+- the scan carry holds, per (array, cache line), the line's last
+  global access position — a dense int64 vector replacing the
+  reference's LAT hash maps (LAT_A/B/C, ...ri-omp-seq.cpp:47-49) —
+  plus the running noshare histogram and access count;
+- each step enumerates one m-chunk, sorts it (chunk-local positions so
+  the packed keys stay within 63 bits), measures within-chunk reuses as
+  adjacent diffs, and joins chunk-boundary reuses against the carry:
+  first-of-group accesses look up the carried last position, exactly
+  `count[tid] - LAT[addr]` across the boundary (:110);
+- share-classified intervals exit per step through the fixed-capacity
+  unique reduction (stacked scan outputs, merged on host);
+- after the scan, surviving carry entries flush as the per-array -1
+  cold counts (:305-319).
+
+The result is bit-identical to sampler/dense.py (tests pin it at
+several chunk sizes) while memory scales with chunk size, not trace
+length — the framework's long-trace analog of sequence-parallel
+streaming. Simulated threads are vmapped as in the dense engine.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MachineConfig
+from ..core.trace import NestTrace, ProgramTrace
+from ..ir import Program
+from ..ops.histogram import N_EXP_BINS, exp_bin, fixed_k_unique
+from ..oracle.serial import OracleResult
+from ..runtime.hist import PRIState
+from .dense import _REF_BITS, _ceil_log2, nest_geometry, packed_ref_keys
+
+# Per-chunk element budget: chunk_m = max(1, _ELEM_BUDGET // acc[0]).
+_ELEM_BUDGET = 1 << 22
+
+
+def _stream_nest_kernel(nt: NestTrace, chunk_m: int, max_share: int):
+    """Build the jitted per-tid scan over m-chunks of one nest."""
+    t = nt.tables
+    sched = nt.schedule
+    machine = nt.machine
+    lmax = sched.max_local_count()
+    n_arrays, max_addr, n_groups = nest_geometry(nt)
+    n_steps = -(-lmax // chunk_m)
+    a0 = int(t.acc_per_level[0])
+    # chunk-local positions for key packing (the full-trace position
+    # would overflow 63 bits at large N); positions leave the packed
+    # domain as plain int64 before reuse arithmetic
+    pos_bits = _ceil_log2(chunk_m * a0 + 1)
+    grp_bits = _ceil_log2(n_groups + 1)
+    assert grp_bits + pos_bits + _REF_BITS <= 63, "key packing overflow"
+
+    local_counts = jnp.array(
+        [sched.local_count(tt) for tt in range(sched.threads)],
+        dtype=jnp.int64,
+    )
+    thr_table = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)
+    ratio_table = jnp.array(t.ref_share_ratios, dtype=jnp.int64)
+    K = machine.chunk_size
+    P = sched.threads
+    step0, start0 = sched.step, sched.start
+
+    def enumerate_chunk(tid, m0):
+        """Packed sort keys of the m-range [m0, m0+chunk_m)."""
+        m = m0 + jnp.arange(chunk_m, dtype=jnp.int64)
+        valid_m = m < local_counts[tid]
+        v0 = start0 + (((m // K) * P + tid) * K + (m % K)) * step0
+        mrel = jnp.arange(chunk_m, dtype=jnp.int64)
+        keys = [
+            packed_ref_keys(
+                nt, ri, v0, mrel, valid_m, pos_bits, max_addr, n_groups
+            )
+            for ri in range(t.n_refs)
+        ]
+        return jnp.sort(jnp.concatenate(keys))
+
+    def step_fn(tid, carry, m0):
+        last_pos, nosh, n_acc = carry
+        key = enumerate_chunk(tid, m0)
+        ref_s = (key & ((1 << _REF_BITS) - 1)).astype(jnp.int32)
+        pos_rel = (key >> _REF_BITS) & ((1 << pos_bits) - 1)
+        grp_s = key >> (_REF_BITS + pos_bits)
+        is_valid = grp_s != (n_groups - 1)
+        # position in the thread's nest-local clock (reuse intervals are
+        # position differences, so any constant offset cancels)
+        pos_g = pos_rel + m0 * a0
+        same = jnp.concatenate(
+            [jnp.array([False]), (grp_s[1:] == grp_s[:-1]) & is_valid[1:]]
+        )
+        prev_in_chunk = jnp.concatenate([jnp.zeros(1, jnp.int64), pos_g[:-1]])
+        # chunk-boundary join: first-of-group looks up the carry
+        carried = last_pos[grp_s]
+        is_first = is_valid & ~same
+        has_prev = same | (is_first & (carried >= 0))
+        prev = jnp.where(same, prev_in_chunk, carried)
+        reuse = jnp.where(has_prev, pos_g - prev, 0)
+        thr = thr_table[ref_s]
+        is_share = has_prev & (thr > 0) & (
+            jnp.abs(reuse) > jnp.abs(reuse - thr)
+        )
+        is_noshare = has_prev & ~is_share
+        e = exp_bin(jnp.maximum(reuse, 1))
+        nosh = nosh.at[e].add(is_noshare.astype(jnp.int64))
+        share_key = reuse * 8 + ratio_table[ref_s]
+        sk, sc, nu = fixed_k_unique(share_key, is_share, max_share)
+        # carry update: last touch per group (positions ascend in-group;
+        # invalid entries scatter -1 into the invalid group, a no-op)
+        last_pos = last_pos.at[grp_s].max(
+            jnp.where(is_valid, pos_g, jnp.int64(-1))
+        )
+        n_acc = n_acc + jnp.sum(is_valid.astype(jnp.int64))
+        return (last_pos, nosh, n_acc), (sk, sc, nu)
+
+    @jax.jit
+    def run_tid(tid, last_pos):
+        """Scan all chunks of one (tid, nest); returns final carry + ys."""
+        nosh = jnp.zeros(N_EXP_BINS, dtype=jnp.int64)
+        n_acc = jnp.int64(0)
+        m0s = jnp.arange(n_steps, dtype=jnp.int64) * chunk_m
+        (last_pos, nosh, n_acc), ys = jax.lax.scan(
+            lambda c, m0: step_fn(tid, c, m0),
+            (last_pos, nosh, n_acc),
+            m0s,
+        )
+        # -1 flush: surviving lines per array (...ri-omp-seq.cpp:305-319)
+        arr_of = (
+            jnp.arange(n_groups - 1, dtype=jnp.int64) // max_addr
+        )
+        cold = jnp.zeros(n_arrays + 1, dtype=jnp.int64).at[
+            jnp.where(last_pos[:-1] >= 0, arr_of, n_arrays)
+        ].add(1)[:n_arrays]
+        return nosh, ys, cold, n_acc
+
+    def fresh_carry():
+        return jnp.full(n_groups, -1, dtype=jnp.int64)
+
+    return run_tid, fresh_carry, n_steps
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_stream(
+    program: Program, machine: MachineConfig, chunk_m: int | None,
+    max_share: int,
+):
+    """Kernels cached per (program, machine, chunking) so repeated runs
+    (e.g. the CLI's speed mode) reuse the jitted executables."""
+    trace = ProgramTrace(program, machine)
+    kernels = []
+    for nt in trace.nests:
+        a0 = int(nt.tables.acc_per_level[0])
+        cm = chunk_m or max(1, _ELEM_BUDGET // max(1, a0))
+        cm = min(cm, max(1, nt.schedule.max_local_count()))
+        kernels.append(_stream_nest_kernel(nt, cm, max_share))
+    return trace, kernels
+
+
+def run_stream(
+    program: Program,
+    machine: MachineConfig,
+    chunk_m: int | None = None,
+    max_share: int = 64,
+) -> OracleResult:
+    """Streaming dense engine -> OracleResult (== run_dense exactly)."""
+    trace, kernels = _compiled_stream(program, machine, chunk_m, max_share)
+    P = machine.thread_num
+    state = PRIState(P)
+    per_tid = [0] * P
+    for run_tid, fresh_carry, _ in kernels:
+        for tid in range(P):
+            nosh, ys, cold, n_acc = jax.device_get(
+                run_tid(jnp.int64(tid), fresh_carry())
+            )
+            sk, sc, nu = ys
+            if int(nu.max(initial=0)) > sk.shape[1]:
+                raise RuntimeError(
+                    "share-value capacity exceeded; raise max_share "
+                    f"(needed {int(nu.max())}, have {sk.shape[1]})"
+                )
+            h = state.noshare[tid]
+            for e_idx in np.nonzero(nosh)[0]:
+                key = 1 << int(e_idx)
+                h[key] = h.get(key, 0.0) + float(nosh[e_idx])
+            c = int(cold.sum())
+            if c:
+                h[-1] = h.get(-1, 0.0) + float(c)
+            for s in range(sk.shape[0]):
+                for key, cnt in zip(sk[s], sc[s]):
+                    if cnt > 0:
+                        reuse, ratio = divmod(int(key), 8)
+                        hs = state.share[tid].setdefault(ratio, {})
+                        hs[reuse] = hs.get(reuse, 0.0) + float(cnt)
+            per_tid[tid] += int(n_acc)
+    return OracleResult(
+        state=state, total_accesses=sum(per_tid), per_tid_accesses=per_tid
+    )

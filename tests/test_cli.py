@@ -17,7 +17,7 @@ def _dump(capsys, argv):
 
 def test_acc_dumps_identical_across_engines(capsys):
     outs = {}
-    engines = ["oracle", "numpy", "dense"]
+    engines = ["oracle", "numpy", "dense", "stream"]
     try:
         from pluss_sampler_optimization_tpu import native
 
