@@ -1,0 +1,96 @@
+"""Pallas TPU kernel for the pow2-binned reuse histogram.
+
+The histogram update is the engines' innermost reduction (the
+reference's `_pluss_histogram_update` hash insert per access,
+pluss_utils.h:680-689; here `exp_hist`'s scatter-add,
+ops/histogram.py). Scatter-adds serialize on the VPU; this kernel
+avoids them entirely with a comparison ladder:
+
+    c_k   = sum over masked values of [x >= 2^k]          (monotone)
+    hist[e] = c_e - c_{e+1}
+
+64 broadcast compares + reductions per block are pure VPU work with no
+data-dependent memory traffic. int64 values are split into uint32
+hi/lo planes before the kernel (TPU vector units are 32-bit native),
+so the full 63-bit reuse range survives.
+
+`pow2_hist` dispatches to the kernel on TPU (interpret mode elsewhere
+only under test); `exp_hist` in ops/histogram.py remains the portable
+default. Equality with exp_hist is pinned by tests/test_pallas.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+N_BINS = 64
+_LANES = 128
+_BLOCK_ROWS = 8
+
+
+def _hist_kernel(hi_ref, lo_ref, w_ref, out_ref):
+    hi = hi_ref[:]
+    lo = lo_ref[:]
+    w = w_ref[:] > 0
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+    acc = jnp.zeros((1, _LANES), dtype=jnp.int32)
+    for k in range(N_BINS):
+        if k < 32:
+            ge = (hi > 0) | (lo >= jnp.uint32(1 << k))
+        else:
+            ge = hi >= jnp.uint32(1 << (k - 32))
+        c_k = jnp.sum(jnp.where(ge & w, jnp.int32(1), jnp.int32(0)))
+        acc = acc + jnp.where(lane == k, c_k, jnp.int32(0))
+    out_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pow2_hist(values, weights, interpret: bool = False):
+    """(64,) int64 histogram of floor(log2(x)) over masked values.
+
+    `values` int64 (> 0 where weights are nonzero), `weights` any
+    integer/bool mask. Equivalent to ops/histogram.py::exp_hist.
+    """
+    values = values.ravel().astype(jnp.int64)
+    w = weights.ravel().astype(jnp.int32)
+    n = values.shape[0]
+    block = _BLOCK_ROWS * _LANES
+    pad = (-n) % block
+    if pad:
+        values = jnp.concatenate([values, jnp.ones(pad, jnp.int64)])
+        w = jnp.concatenate([w, jnp.zeros(pad, jnp.int32)])
+    rows = (n + pad) // _LANES
+    hi = (values >> 32).astype(jnp.uint32).reshape(rows, _LANES)
+    lo = (values & 0xFFFFFFFF).astype(jnp.uint32).reshape(rows, _LANES)
+    w2 = w.reshape(rows, _LANES)
+    grid = rows // _BLOCK_ROWS
+
+    partial = pl.pallas_call(
+        _hist_kernel,
+        out_shape=jax.ShapeDtypeStruct((grid, _LANES), jnp.int32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(hi, lo, w2)
+
+    c = jnp.sum(partial, axis=0, dtype=jnp.int64)[:N_BINS]
+    # hist[e] = c_e - c_{e+1}; c_63 counts x >= 2^63 (none: reuse < 2^63)
+    return c - jnp.concatenate([c[1:], jnp.zeros(1, jnp.int64)])
+
+
+def pow2_hist_auto(values, weights):
+    """Kernel on TPU, portable exp_hist elsewhere."""
+    from .histogram import exp_hist
+
+    if jax.default_backend() == "tpu":
+        return pow2_hist(values, weights)
+    return exp_hist(values, weights)

@@ -60,11 +60,18 @@ def _build_sharded_ref_kernel(
     axis = mesh.axis_names[0]
     check_packed_ratios(nt)
 
+    import os
+
+    if os.environ.get("PLUSS_PALLAS_HIST") == "1":
+        from ..ops.pallas_hist import pow2_hist_auto as _hist_fn
+    else:
+        _hist_fn = exp_hist
+
     def local_fn(samples, weights):
         packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
         w = weights.astype(bool)
         # scalable output: dense pow2 noshare histogram, psum over ICI
-        nosh_hist = exp_hist(jnp.maximum(ri, 1), (found & ~is_share & w))
+        nosh_hist = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
         nosh_hist = jax.lax.psum(nosh_hist, axis)
         cold = jax.lax.psum(jnp.sum((~found & w).astype(jnp.int64)), axis)
         # exact output: per-device unique (reuse, class) pairs
