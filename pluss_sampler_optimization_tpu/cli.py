@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 
 def _build_model(name: str, n: int, tsteps: int):
@@ -97,7 +96,7 @@ def _run_engine(engine: str, program, machine, args):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
-    ap.add_argument("mode", choices=["acc", "speed", "sample"])
+    ap.add_argument("mode", choices=["acc", "speed", "sample", "trace"])
     ap.add_argument("--model", default="gemm",
                     help="gemm | 2mm | 3mm | syrk | jacobi-2d")
     ap.add_argument("--n", type=int, default=128)
@@ -113,6 +112,11 @@ def main(argv=None) -> int:
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
+    ap.add_argument("--min-reuse", type=int, default=512,
+                    help="trace mode reuse-pair threshold (DEBUG >= 512)")
+    ap.add_argument("--limit", type=int, default=50,
+                    help="trace mode row limit")
     ap.add_argument("--mrc-out", default=None,
                     help="also write the MRC to this file")
     ap.add_argument(
@@ -155,14 +159,40 @@ def main(argv=None) -> int:
     if args.mode == "sample" and engine not in ("sampled", "sharded"):
         raise SystemExit("sample mode needs --engine sampled|sharded")
 
+    if args.mode == "trace":
+        # the reference's -DDEBUG access/reuse logs (runtime/debug.py)
+        from .core.trace import ProgramTrace
+        from .runtime.debug import (
+            access_trace,
+            format_reuse_pairs,
+            reuse_pairs,
+        )
+
+        trace = ProgramTrace(program, machine)
+        print(f"access trace, tid {args.tid}:")
+        for row in access_trace(program, machine, args.tid, args.limit,
+                                trace=trace):
+            print("  pos %d  %s line %d  %s" % row)
+        pairs = reuse_pairs(
+            program, machine, args.tid, args.min_reuse, args.limit,
+            trace=trace,
+        )
+        print(f"reuse pairs >= {args.min_reuse}, tid {args.tid}:")
+        for line in format_reuse_pairs(pairs):
+            print("  " + line)
+        return 0
+
     if args.mode == "speed":
-        # Makefile:34-37 / main.rs:31-33: repeated timed runs.
-        times = []
-        for rep in range(args.reps):
-            t0 = time.perf_counter()
-            _run_engine(engine, program, machine, args)
-            dt = time.perf_counter() - t0
-            times.append(dt)
+        # Makefile:34-37 / main.rs:31-33: repeated timed runs after a
+        # cache flush (pluss_timer_start flushes 2.5MB, pluss.cpp:86-94)
+        from .runtime.timing import timed
+
+        times, _ = timed(
+            lambda: _run_engine(engine, program, machine, args),
+            reps=args.reps,
+            flush_kb=machine.cache_kb,
+        )
+        for rep, dt in enumerate(times):
             print(f"{engine} {program.name} run {rep}: {dt:.6f} s")
         print(
             f"{engine} {program.name}: best {min(times):.6f} s, "

@@ -77,6 +77,10 @@ class SamplerConfig:
     # Upper bound on distinct raw share-reuse values collected device-side
     # per (ref, shard) before host-side exact sparse accumulation.
     max_share_values: int = 64
+    # Use the Pallas comparison-ladder histogram kernel
+    # (ops/pallas_hist.py) for the sharded engine's dense noshare
+    # reduction; dispatches to the kernel only on a TPU backend.
+    use_pallas_hist: bool = False
 
     def num_samples(self, trips) -> int:
         import math

@@ -35,7 +35,7 @@ _BLOCK_ROWS = 8
 def _hist_kernel(hi_ref, lo_ref, w_ref, out_ref):
     hi = hi_ref[:]
     lo = lo_ref[:]
-    w = w_ref[:] > 0
+    w = w_ref[:]
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
     acc = jnp.zeros((1, _LANES), dtype=jnp.int32)
     for k in range(N_BINS):
@@ -43,21 +43,26 @@ def _hist_kernel(hi_ref, lo_ref, w_ref, out_ref):
             ge = (hi > 0) | (lo >= jnp.uint32(1 << k))
         else:
             ge = hi >= jnp.uint32(1 << (k - 32))
-        c_k = jnp.sum(jnp.where(ge & w, jnp.int32(1), jnp.int32(0)))
+        c_k = jnp.sum(jnp.where(ge, w, jnp.int32(0)))
         acc = acc + jnp.where(lane == k, c_k, jnp.int32(0))
     out_ref[:] = acc
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pow2_hist(values, weights, interpret: bool = False):
-    """(64,) int64 histogram of floor(log2(x)) over masked values.
+    """(64,) int64 histogram of floor(log2(x)) weighted by `weights`.
 
-    `values` int64 (> 0 where weights are nonzero), `weights` any
-    integer/bool mask. Equivalent to ops/histogram.py::exp_hist.
+    `values` int64 (> 0 where weights are nonzero); `weights` are added
+    per entry like exp_hist (bool masks and int32-range counts; the
+    per-block partial sums are int32, so keep per-call weight totals
+    below 2^31). Equivalent to ops/histogram.py::exp_hist within that
+    range.
     """
     values = values.ravel().astype(jnp.int64)
     w = weights.ravel().astype(jnp.int32)
     n = values.shape[0]
+    if n == 0:
+        return jnp.zeros(N_BINS, dtype=jnp.int64)
     block = _BLOCK_ROWS * _LANES
     pad = (-n) % block
     if pad:

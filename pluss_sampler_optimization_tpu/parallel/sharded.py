@@ -54,15 +54,14 @@ from .mesh import build_mesh
 
 
 def _build_sharded_ref_kernel(
-    nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int
+    nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int,
+    use_pallas_hist: bool = False,
 ):
     """jit(shard_map) kernel: sharded samples -> reduced histograms."""
     axis = mesh.axis_names[0]
     check_packed_ratios(nt)
 
-    import os
-
-    if os.environ.get("PLUSS_PALLAS_HIST") == "1":
+    if use_pallas_hist:
         from ..ops.pallas_hist import pow2_hist_auto as _hist_fn
     else:
         _hist_fn = exp_hist
@@ -93,13 +92,17 @@ def _sharded_program_kernels(
     machine: MachineConfig,
     mesh: jax.sharding.Mesh,
     capacity: int,
+    use_pallas_hist: bool = False,
 ):
     trace = ProgramTrace(program, machine)
     kernels = []
     for k, nt in enumerate(trace.nests):
         for ri in range(nt.tables.n_refs):
             kernels.append(
-                (k, ri, _build_sharded_ref_kernel(nt, ri, mesh, capacity))
+                (k, ri,
+                 _build_sharded_ref_kernel(
+                     nt, ri, mesh, capacity, use_pallas_hist
+                 ))
             )
     return trace, kernels
 
@@ -117,7 +120,9 @@ def sampled_outputs_sharded(
     cfg = cfg or SamplerConfig()
     mesh = mesh or build_mesh()
     n_dev = mesh.devices.size
-    trace, kernels = _sharded_program_kernels(program, machine, mesh, capacity)
+    trace, kernels = _sharded_program_kernels(
+        program, machine, mesh, capacity, cfg.use_pallas_hist
+    )
     results = []
     dense_noshare = []
     for idx, (k, ri, kernel) in enumerate(kernels):

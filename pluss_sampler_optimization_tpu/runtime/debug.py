@@ -1,0 +1,106 @@
+"""Debug tracing — the reference's -DDEBUG surfaces as first-class API.
+
+The reference compiles per-access logging only in DEBUG builds
+(Makefile:15 commented flag): chunk assignment and access traces
+(...ri.cpp:94-121), reuse source->sink pairs above a threshold
+(...ri.cpp prints pairs >= 512; ...rs-ri-opt-r10.cpp:538-543,566-568),
+and a full-Iteration LAT map (...ri.cpp:50-52). Here the same
+information is always available, computed from the closed-form trace:
+
+- `access_trace`: one simulated thread's access stream in execution
+  order (position, array, cache line, ref) — the DEBUG access log;
+- `reuse_pairs`: every (source position, sink position, interval) pair
+  with interval >= min_reuse — the DEBUG reuse log, produced by the
+  same lexsort the dense engine uses rather than a hash walk;
+- the sampled engine's per-sample surface is sampler/sampled.py::
+  per_sample_ri (the r10 DEBUG print equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..config import MachineConfig
+from ..core.trace import ProgramTrace
+from ..ir import Program
+
+
+@dataclasses.dataclass
+class ReusePair:
+    source_pos: int
+    sink_pos: int
+    reuse: int
+    array: int
+    line: int
+    source_ref: str
+    sink_ref: str
+
+
+def access_trace(
+    program: Program, machine: MachineConfig, tid: int, limit: int = 100,
+    trace: ProgramTrace | None = None,
+):
+    """First `limit` accesses of one simulated thread, execution order.
+
+    Returns rows of (position, array name, cache line, ref name) — the
+    DEBUG access log (...ri.cpp:94-121). Pass a prebuilt `trace` to
+    reuse the enumeration across calls (the CLI's trace mode does).
+    """
+    trace = trace or ProgramTrace(program, machine)
+    pos, addr, arr, ref = trace.enumerate_tid(tid)
+    order = np.argsort(pos, kind="stable")[:limit]
+    _, _, names = trace.ref_global_tables()
+    arrays = program.arrays
+    return [
+        (int(pos[i]), arrays[int(arr[i])], int(addr[i]), names[int(ref[i])])
+        for i in order
+    ]
+
+
+def reuse_pairs(
+    program: Program,
+    machine: MachineConfig,
+    tid: int,
+    min_reuse: int = 512,
+    limit: int = 1000,
+    trace: ProgramTrace | None = None,
+):
+    """All same-line reuse pairs of one thread with interval >= min_reuse
+    (the DEBUG 'src -> sink' log, ...ri.cpp reuse prints)."""
+    trace = trace or ProgramTrace(program, machine)
+    pos, addr, arr, ref = trace.enumerate_tid(tid)
+    if len(pos) == 0:  # idle simulated thread (fewer chunks than tids)
+        return []
+    order = np.lexsort((pos, addr, arr))
+    pos_s, addr_s, arr_s, ref_s = (
+        pos[order], addr[order], arr[order], ref[order]
+    )
+    same = np.empty(len(pos_s), dtype=bool)
+    same[0] = False
+    same[1:] = (addr_s[1:] == addr_s[:-1]) & (arr_s[1:] == arr_s[:-1])
+    reuse = np.where(same, pos_s - np.roll(pos_s, 1), -1)
+    take = np.flatnonzero(same & (reuse >= min_reuse))[:limit]
+    _, _, names = trace.ref_global_tables()
+    return [
+        ReusePair(
+            source_pos=int(pos_s[i - 1]),
+            sink_pos=int(pos_s[i]),
+            reuse=int(reuse[i]),
+            array=int(arr_s[i]),
+            line=int(addr_s[i]),
+            source_ref=names[int(ref_s[i - 1])],
+            sink_ref=names[int(ref_s[i])],
+        )
+        for i in take
+    ]
+
+
+def format_reuse_pairs(pairs) -> list[str]:
+    """'[reuse] source -> sink' lines (r10 DEBUG format, :566-568)."""
+    return [
+        f"[{p.reuse}] {p.source_ref}@{p.source_pos} -> "
+        f"{p.sink_ref}@{p.sink_pos} (array {p.array}, line {p.line})"
+        for p in pairs
+    ]

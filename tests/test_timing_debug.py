@@ -1,0 +1,75 @@
+"""Timing runtime and debug-trace surfaces."""
+
+from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.oracle.serial import run_serial
+from pluss_sampler_optimization_tpu.runtime.debug import (
+    access_trace,
+    format_reuse_pairs,
+    reuse_pairs,
+)
+from pluss_sampler_optimization_tpu.runtime.timing import (
+    Timer,
+    flush_cache,
+    timed,
+)
+
+MACHINE = MachineConfig()
+
+
+def test_timer_and_flush():
+    assert flush_cache() == 0.0
+    t = Timer(cycle_accurate=True)
+    t.start()
+    x = sum(range(1000))
+    assert t.stop() > 0
+    assert t.cycles > 0 and x == 499500
+
+
+def test_timed_reps():
+    times, result = timed(lambda: 42, reps=3, flush=False)
+    assert len(times) == 3 and result == 42
+
+
+def test_access_trace_order_and_refs():
+    rows = access_trace(gemm(8), MACHINE, tid=0, limit=8)
+    # GEMM body order: C0, C1, then (A0, B0, C2, C3) per k iteration
+    assert [r[3] for r in rows] == [
+        "C0", "C1", "A0", "B0", "C2", "C3", "A0", "B0"
+    ]
+    assert [r[0] for r in rows] == list(range(8))
+    assert rows[0][1] == "C" and rows[2][1] == "A" and rows[3][1] == "B"
+
+
+def test_reuse_pairs_match_oracle_totals():
+    """Every reuse pair (threshold 1) is one histogram count."""
+    prog = gemm(8)
+    total_pairs = 0
+    for tid in range(MACHINE.thread_num):
+        total_pairs += len(
+            reuse_pairs(prog, MACHINE, tid, min_reuse=1, limit=10**9)
+        )
+    oracle = run_serial(prog, MACHINE)
+    total_hist = sum(
+        sum(v for k, v in h.items() if k != -1)
+        for h in oracle.state.noshare
+    ) + sum(
+        sum(h2.values())
+        for per in oracle.state.share
+        for h2 in per.values()
+    )
+    assert total_pairs == total_hist
+
+
+def test_format_reuse_pairs():
+    pairs = reuse_pairs(gemm(8), MACHINE, 0, min_reuse=1, limit=3)
+    lines = format_reuse_pairs(pairs)
+    assert len(lines) == 3 and all("->" in l for l in lines)
+
+
+def test_cli_trace_mode(capsys):
+    assert main(["trace", "--model", "gemm", "--n", "8", "--min-reuse",
+                 "4", "--limit", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "access trace" in out and "reuse pairs" in out and "->" in out
