@@ -70,7 +70,10 @@ def _run_engine(engine: str, program, machine, args):
     if engine in ("sampled", "sharded"):
         from .config import SamplerConfig
 
-        cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
+        cfg = SamplerConfig(
+            ratio=args.ratio, seed=args.seed,
+            use_pallas_hist=args.pallas_hist,
+        )
         v2 = args.runtime == "v2"
         if engine == "sampled":
             from .sampler.sampled import run_sampled
@@ -111,6 +114,9 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pallas-hist", action="store_true",
+                    help="sharded engine: reduce histograms with the "
+                    "Pallas TPU kernel instead of XLA scatter-add")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
     ap.add_argument("--min-reuse", type=int, default=512,
@@ -158,6 +164,11 @@ def main(argv=None) -> int:
     engine = args.engine or ("sampled" if args.mode == "sample" else "dense")
     if args.mode == "sample" and engine not in ("sampled", "sharded"):
         raise SystemExit("sample mode needs --engine sampled|sharded")
+    if args.pallas_hist and engine != "sharded":
+        raise SystemExit(
+            "--pallas-hist applies to --engine sharded only (other "
+            "engines reduce exact sparse pairs, not binned histograms)"
+        )
 
     if args.mode == "trace":
         # the reference's -DDEBUG access/reuse logs (runtime/debug.py)

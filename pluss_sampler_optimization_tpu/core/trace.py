@@ -103,7 +103,10 @@ class NestTrace:
         level = int(self.tables.ref_levels[ref_idx])
         return tuple(lp.trip for lp in self.nest.loops[: level + 1])
 
-    def enumerate_ref(self, tid: int, ref_idx: int, schedule=None):
+    def enumerate_ref(
+        self, tid: int, ref_idx: int, schedule=None,
+        m_lo: int = 0, m_hi: int | None = None,
+    ):
         """All accesses of (tid, ref): returns (positions, addrs) int64.
 
         Vectorized numpy enumeration; the concatenation over refs is the
@@ -111,15 +114,18 @@ class NestTrace:
         position array carries the ordering). `schedule` overrides the
         nest's round-robin static schedule (any object with
         local_count/local_to_value; the executing profiler passes its
-        contiguous row-block split, oracle/profiler.py).
+        contiguous row-block split, oracle/profiler.py). `m_lo`/`m_hi`
+        restrict to a window of thread-local parallel iterations so
+        long traces can stream in bounded memory (runtime/debug.py).
         """
         sched = schedule if schedule is not None else self.schedule
         level = int(self.tables.ref_levels[ref_idx])
         L = sched.local_count(tid)
-        if L == 0:
+        L = L if m_hi is None else min(L, m_hi)
+        if L <= m_lo:
             z = np.zeros(0, dtype=np.int64)
             return z, z.copy()
-        m = np.arange(L, dtype=np.int64)
+        m = np.arange(m_lo, L, dtype=np.int64)
         v0 = sched.local_to_value(tid, m)
         if level == 0:
             pos = self.access_position(ref_idx, m)
@@ -201,6 +207,32 @@ class ProgramTrace:
                 )
                 ref_all.append(np.full(pos.shape, gid, dtype=np.int64))
                 gid += 1
+        return (
+            np.concatenate(pos_all),
+            np.concatenate(addr_all),
+            np.concatenate(arr_all),
+            np.concatenate(ref_all),
+        )
+
+    def enumerate_tid_window(
+        self, tid: int, nest_index: int, m_lo: int, m_hi: int
+    ):
+        """One nest's accesses for thread-local parallel iterations
+        [m_lo, m_hi) — same arrays as enumerate_tid, bounded memory."""
+        nt = self.nests[nest_index]
+        off = self.nest_offset(nest_index, tid)
+        gid0 = sum(
+            self.nests[k].tables.n_refs for k in range(nest_index)
+        )
+        pos_all, addr_all, arr_all, ref_all = [], [], [], []
+        for ri in range(nt.tables.n_refs):
+            pos, addr = nt.enumerate_ref(tid, ri, m_lo=m_lo, m_hi=m_hi)
+            pos_all.append(pos + off)
+            addr_all.append(addr)
+            arr_all.append(
+                np.full(pos.shape, nt.tables.ref_arrays[ri], dtype=np.int64)
+            )
+            ref_all.append(np.full(pos.shape, gid0 + ri, dtype=np.int64))
         return (
             np.concatenate(pos_all),
             np.concatenate(addr_all),

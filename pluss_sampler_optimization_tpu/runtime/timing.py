@@ -26,7 +26,11 @@ def flush_cache(cache_kb: int = _CACHE_SIZE_KB) -> float:
     """`_polybench_flush_cache` (pluss.cpp:71-81): walk a buffer larger
     than the LLC; returns the sum so the work cannot be elided."""
     cs = cache_kb * 1024 // 8
-    buf = np.zeros(cs, dtype=np.float64)
+    # np.empty + fill dirties distinct physical pages; calloc-backed
+    # np.zeros would alias every read onto the shared zero page and
+    # leave the LLC warm.
+    buf = np.empty(cs, dtype=np.float64)
+    buf.fill(0.0)
     s = float(buf.sum())
     assert s <= 10.0  # polybench's own guard (pluss.cpp:79)
     return s

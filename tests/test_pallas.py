@@ -21,6 +21,18 @@ def test_pallas_hist_matches_exp_hist(n):
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
+@pytest.mark.parametrize("n", [100, 5000])
+def test_pallas_hist_additive_weights(n):
+    """Weights are additive multiplicities, not a mask: counts > 1 per
+    element must accumulate (distinguishes the kernel from w > 0)."""
+    rng = np.random.default_rng(n + 7)
+    vals = rng.integers(1, 1 << 40, size=n)
+    w = rng.integers(0, 5, size=n)
+    ref = exp_hist(jnp.asarray(vals), jnp.asarray(w))
+    got = pow2_hist(jnp.asarray(vals), jnp.asarray(w), interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
 def test_pallas_hist_boundary_values():
     vals = np.array(
         [1, 2, 3, 4, (1 << 31) - 1, 1 << 31, (1 << 32) - 1, 1 << 32,
