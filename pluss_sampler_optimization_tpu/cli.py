@@ -26,23 +26,15 @@ import sys
 
 
 def _build_model(name: str, n: int, tsteps: int):
-    from .models.gemm import gemm
-    from .models.jacobi2d import jacobi2d
-    from .models.mm2 import mm2
-    from .models.mm3 import mm3
-    from .models.syrk import syrk_rect
+    from .models import REGISTRY
 
-    if name == "gemm":
-        return gemm(n)
-    if name == "2mm":
-        return mm2(n)
-    if name == "3mm":
-        return mm3(n)
-    if name == "syrk":
-        return syrk_rect(n)
+    if name not in REGISTRY:
+        raise SystemExit(
+            f"unknown model {name!r} (have {', '.join(sorted(REGISTRY))})"
+        )
     if name == "jacobi-2d":
-        return jacobi2d(n, tsteps=tsteps)
-    raise SystemExit(f"unknown model {name!r}")
+        return REGISTRY[name](n, tsteps=tsteps)
+    return REGISTRY[name](n)
 
 
 def _run_engine(engine: str, program, machine, args):
@@ -101,7 +93,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
     ap.add_argument("mode", choices=["acc", "speed", "sample", "trace"])
     ap.add_argument("--model", default="gemm",
-                    help="gemm | 2mm | 3mm | syrk | jacobi-2d")
+                    help="gemm | 2mm | 3mm | syrk | jacobi-2d | mvt | "
+                    "bicg | gesummv")
     ap.add_argument("--n", type=int, default=128)
     ap.add_argument("--tsteps", type=int, default=1, help="jacobi-2d only")
     ap.add_argument(

@@ -191,27 +191,14 @@ class ProgramTrace:
         Returns int64 arrays (positions, addrs, array_ids, ref_gids)
         where ref_gids index `self.program.refs`.
         """
-        pos_all: list[np.ndarray] = []
-        addr_all: list[np.ndarray] = []
-        arr_all: list[np.ndarray] = []
-        ref_all: list[np.ndarray] = []
-        gid = 0
-        for k, nt in enumerate(self.nests):
-            off = self.nest_offset(k, tid)
-            for ri in range(nt.tables.n_refs):
-                pos, addr = nt.enumerate_ref(tid, ri)
-                pos_all.append(pos + off)
-                addr_all.append(addr)
-                arr_all.append(
-                    np.full(pos.shape, nt.tables.ref_arrays[ri], dtype=np.int64)
-                )
-                ref_all.append(np.full(pos.shape, gid, dtype=np.int64))
-                gid += 1
-        return (
-            np.concatenate(pos_all),
-            np.concatenate(addr_all),
-            np.concatenate(arr_all),
-            np.concatenate(ref_all),
+        parts = [
+            self.enumerate_tid_window(
+                tid, k, 0, nt.schedule.local_count(tid)
+            )
+            for k, nt in enumerate(self.nests)
+        ]
+        return tuple(
+            np.concatenate([p[c] for p in parts]) for c in range(4)
         )
 
     def enumerate_tid_window(

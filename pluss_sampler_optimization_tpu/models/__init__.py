@@ -5,11 +5,14 @@ generated samplers (or the analogous PolyBench kernel for benchmarks the
 reference's BASELINE configs name but ship no generated sampler for).
 """
 
+from .bicg import bicg
 from .gemm import gemm
+from .gesummv import gesummv
+from .jacobi2d import jacobi2d
 from .mm2 import mm2
 from .mm3 import mm3
+from .mvt import mvt
 from .syrk import syrk_rect
-from .jacobi2d import jacobi2d
 
 REGISTRY = {
     "gemm": gemm,
@@ -17,6 +20,12 @@ REGISTRY = {
     "3mm": mm3,
     "syrk": syrk_rect,
     "jacobi-2d": jacobi2d,
+    "mvt": mvt,
+    "bicg": bicg,
+    "gesummv": gesummv,
 }
 
-__all__ = ["gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "REGISTRY"]
+__all__ = [
+    "gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "mvt", "bicg",
+    "gesummv", "REGISTRY",
+]

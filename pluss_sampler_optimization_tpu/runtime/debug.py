@@ -47,15 +47,17 @@ class ReusePair:
     sink_ref: str
 
 
-def _windows(trace: ProgramTrace, tid: int):
+def _windows(trace: ProgramTrace, tid: int, max_accesses: int | None = None):
     """Yield (nest_index, m_lo, m_hi) covering the thread's stream in
-    position order, each window bounded to ~_WINDOW_ACCESSES."""
+    position order, each window bounded to ~_WINDOW_ACCESSES (or to
+    `max_accesses` when the caller only consumes that many rows)."""
+    cap = _WINDOW_ACCESSES if max_accesses is None else max_accesses
     for k, nt in enumerate(trace.nests):
         total_m = nt.schedule.local_count(tid)
         if total_m == 0:
             continue
         acc0 = max(1, int(nt.acc[0]))
-        step = max(1, _WINDOW_ACCESSES // acc0)
+        step = max(1, min(_WINDOW_ACCESSES, cap + acc0 - 1) // acc0)
         for m_lo in range(0, total_m, step):
             yield k, m_lo, min(total_m, m_lo + step)
 
@@ -74,7 +76,7 @@ def access_trace(
     _, _, names = trace.ref_global_tables()
     arrays = program.arrays
     rows: list[tuple[int, str, int, str]] = []
-    for k, m_lo, m_hi in _windows(trace, tid):
+    for k, m_lo, m_hi in _windows(trace, tid, max_accesses=limit):
         pos, addr, arr, ref = trace.enumerate_tid_window(tid, k, m_lo, m_hi)
         order = np.argsort(pos, kind="stable")[: limit - len(rows)]
         rows.extend(
@@ -122,7 +124,15 @@ def reuse_pairs(
                 )
             )
 
+    cur_nest = -1
     for k, m_lo, m_hi in _windows(trace, tid):
+        if k != cur_nest:
+            # the reference clears every LAT after each parallel loop —
+            # reuse never crosses a nest boundary (ir.py, Program docs)
+            c_keys = np.zeros(0, dtype=np.int64)
+            c_pos = np.zeros(0, dtype=np.int64)
+            c_ref = np.zeros(0, dtype=np.int64)
+            cur_nest = k
         pos, addr, arr, ref = trace.enumerate_tid_window(tid, k, m_lo, m_hi)
         if len(pos) == 0:
             continue

@@ -3,7 +3,16 @@
 import pytest
 
 from pluss_sampler_optimization_tpu.config import MachineConfig
-from pluss_sampler_optimization_tpu.models import gemm, jacobi2d, mm2, mm3, syrk_rect
+from pluss_sampler_optimization_tpu.models import (
+    bicg,
+    gemm,
+    gesummv,
+    jacobi2d,
+    mm2,
+    mm3,
+    mvt,
+    syrk_rect,
+)
 from pluss_sampler_optimization_tpu.oracle import run_numpy
 from pluss_sampler_optimization_tpu.sampler import run_dense
 
@@ -16,6 +25,9 @@ PROGRAMS = [
     mm3(6),
     syrk_rect(8),
     jacobi2d(10, tsteps=2),
+    mvt(16),
+    bicg(13, 17),
+    gesummv(16),
 ]
 
 

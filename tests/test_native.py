@@ -3,10 +3,13 @@
 import pytest
 
 from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.models.bicg import bicg
 from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.models.gesummv import gesummv
 from pluss_sampler_optimization_tpu.models.jacobi2d import jacobi2d
 from pluss_sampler_optimization_tpu.models.mm2 import mm2
 from pluss_sampler_optimization_tpu.models.mm3 import mm3
+from pluss_sampler_optimization_tpu.models.mvt import mvt
 from pluss_sampler_optimization_tpu.models.syrk import syrk_rect
 from pluss_sampler_optimization_tpu.oracle.serial import run_serial
 
@@ -33,7 +36,7 @@ def _results_equal(a, b):
 @pytest.mark.parametrize(
     "prog",
     [gemm(16), gemm(17), mm2(12), mm3(8), syrk_rect(12),
-     jacobi2d(10, tsteps=2)],
+     jacobi2d(10, tsteps=2), mvt(16), bicg(13, 17), gesummv(16)],
     ids=lambda p: p.name,
 )
 def test_native_matches_python_oracle(prog):

@@ -12,7 +12,15 @@ import pytest
 
 from pluss_sampler_optimization_tpu.config import MachineConfig, SamplerConfig
 from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
-from pluss_sampler_optimization_tpu.models import gemm, jacobi2d, mm2, syrk_rect
+from pluss_sampler_optimization_tpu.models import (
+    bicg,
+    gemm,
+    gesummv,
+    jacobi2d,
+    mm2,
+    mvt,
+    syrk_rect,
+)
 from pluss_sampler_optimization_tpu.sampler.sampled import (
     draw_samples,
     per_sample_ri,
@@ -49,6 +57,9 @@ PROGRAMS = [
     (mm2(8), None),
     (syrk_rect(8), None),
     (jacobi2d(10, tsteps=2), None),
+    (mvt(10), None),  # transposed A[j][i]
+    (bicg(9, 11), None),  # 1-deep nest + written share refs
+    (gesummv(10), None),  # post-slot level-0 refs
 ]
 
 

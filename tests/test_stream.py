@@ -4,8 +4,10 @@ import pytest
 
 from pluss_sampler_optimization_tpu import MachineConfig
 from pluss_sampler_optimization_tpu.models.gemm import gemm
+from pluss_sampler_optimization_tpu.models.gesummv import gesummv
 from pluss_sampler_optimization_tpu.models.jacobi2d import jacobi2d
 from pluss_sampler_optimization_tpu.models.mm2 import mm2
+from pluss_sampler_optimization_tpu.models.mvt import mvt
 from pluss_sampler_optimization_tpu.sampler.dense import run_dense
 from pluss_sampler_optimization_tpu.sampler.stream import run_stream
 
@@ -51,3 +53,9 @@ def test_stream_odd_machine():
     m = MachineConfig(thread_num=3, chunk_size=5)
     prog = gemm(14)
     _results_equal(run_dense(prog, m), run_stream(prog, m, 2))
+
+
+def test_stream_matches_dense_mvt_gesummv():
+    # transposed access + post-slot level-0 refs under the scan carry
+    for prog in (mvt(16), gesummv(16)):
+        _results_equal(run_dense(prog, MACHINE), run_stream(prog, MACHINE, 3))

@@ -43,23 +43,28 @@ def test_access_trace_order_and_refs():
 
 
 def test_reuse_pairs_match_oracle_totals():
-    """Every reuse pair (threshold 1) is one histogram count."""
-    prog = gemm(8)
-    total_pairs = 0
-    for tid in range(MACHINE.thread_num):
-        total_pairs += len(
-            reuse_pairs(prog, MACHINE, tid, min_reuse=1, limit=10**9)
+    """Every reuse pair (threshold 1) is one histogram count; reuse
+    never crosses a parallel-nest boundary (multi-nest bicg pins the
+    per-nest LAT reset the reference performs after every parallel
+    loop, ...ri-omp-seq.cpp:303-319)."""
+    from pluss_sampler_optimization_tpu.models.bicg import bicg
+
+    for prog in (gemm(8), bicg(8, 8)):
+        total_pairs = 0
+        for tid in range(MACHINE.thread_num):
+            total_pairs += len(
+                reuse_pairs(prog, MACHINE, tid, min_reuse=1, limit=10**9)
+            )
+        oracle = run_serial(prog, MACHINE)
+        total_hist = sum(
+            sum(v for k, v in h.items() if k != -1)
+            for h in oracle.state.noshare
+        ) + sum(
+            sum(h2.values())
+            for per in oracle.state.share
+            for h2 in per.values()
         )
-    oracle = run_serial(prog, MACHINE)
-    total_hist = sum(
-        sum(v for k, v in h.items() if k != -1)
-        for h in oracle.state.noshare
-    ) + sum(
-        sum(h2.values())
-        for per in oracle.state.share
-        for h2 in per.values()
-    )
-    assert total_pairs == total_hist
+        assert total_pairs == total_hist, prog.name
 
 
 def test_format_reuse_pairs():
