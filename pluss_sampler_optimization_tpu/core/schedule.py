@@ -108,3 +108,33 @@ class StaticSchedule:
 
     def local_to_value(self, tid, m):
         return self.value(self.local_to_normalized(tid, m))
+
+
+def interleaved_order_key(nest_trace, ref_idx: int, samples):
+    """Interleaved-execution order of same-reference samples, as one
+    int64 sort key.
+
+    The reference's sampled variant processes each reference's random
+    samples through a priority queue ordered by `IterationComp`
+    (Iteration::compare, src/iteration.rs:63-134; same logic in
+    pluss_utils.h:95-164): chunk round (cid) first, then position
+    within the chunk, then the inner loop variables — the simulated
+    thread id is deliberately never compared, because the uniform
+    interleaving advances all threads' equal-cid/pos iterations
+    together. Per-reference queues never compare across references, so
+    the trailing priority tiebreak (ref program order) never fires
+    there; sorting by this key reproduces the queue's pop order for
+    the samples of one reference.
+
+    `samples` is an (S, depth) array of normalized indices (as produced
+    by sampler/sampled.py::draw_samples); returns (S,) int64 keys whose
+    ascending order is the interleaved execution order.
+    """
+    t = nest_trace.tables
+    sched = nest_trace.schedule
+    lv = int(t.ref_levels[ref_idx])
+    n0 = samples[:, 0]
+    key = sched.local_index(n0)  # (cid, pos) collapsed, tid excluded
+    for l in range(1, lv + 1):
+        key = key * int(t.trips[l]) + samples[:, l]
+    return key

@@ -67,6 +67,7 @@ def _build_sharded_ref_kernel(
         _hist_fn = exp_hist
 
     def local_fn(samples, weights):
+        samples = samples.astype(jnp.int64)  # int32 on the wire
         packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
         w = weights.astype(bool)
         # scalable output: dense pow2 noshare histogram, psum over ICI
@@ -140,7 +141,7 @@ def sampled_outputs_sharded(
                 total=step if len(samples) > step else None,
             )
             nh, c, keys, counts, n_unique = jax.device_get(
-                kernel(jnp.asarray(chunk), jnp.asarray(w))
+                kernel(jnp.asarray(chunk.astype(np.int32)), jnp.asarray(w))
             )
             keys = keys.reshape(n_dev, capacity)
             counts = counts.reshape(n_dev, capacity)

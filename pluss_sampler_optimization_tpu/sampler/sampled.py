@@ -179,11 +179,17 @@ def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
 
 
 def _build_ref_kernel(nt: NestTrace, ref_idx: int):
-    """jitted (samples, weights) -> packed unique pairs + cold count."""
+    """jitted (samples, weights) -> packed unique pairs + cold count.
+
+    Samples arrive as int32 (coordinates always fit; halves the
+    host->device transfer, which crosses a network tunnel when the TPU
+    is remote) and are widened on device.
+    """
     check_packed_ratios(nt)
 
     @functools.partial(jax.jit, static_argnames=("capacity",))
     def kernel(samples, weights, capacity: int):
+        samples = samples.astype(jnp.int64)
         packed, _, _, found = classify_samples(nt, ref_idx, samples)
         w = weights.astype(bool)
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
@@ -283,17 +289,35 @@ def sampled_outputs(
         noshare: dict[int, float] = {}
         share: dict[int, dict[int, float]] = {}
         cold = 0.0
+        cap = capacity
+        pending: list = []  # pipelined async dispatches (depth-bounded)
+
+        def drain(entry):
+            nonlocal cold, cap
+            out, chunk, w, dispatch_cap = entry
+            keys, counts, n_unique, c = jax.device_get(out)
+            while int(n_unique) > dispatch_cap:
+                # rare: more distinct (reuse, class) pairs than slots —
+                # recompile with a larger capacity rather than abort
+                cap = dispatch_cap = max(cap * 4, int(n_unique))
+                keys, counts, n_unique, c = jax.device_get(
+                    kernel(chunk, w, dispatch_cap)
+                )
+            cold += float(c)
+            decode_pairs(keys, counts, noshare, share)
+
         for s0 in range(0, len(samples), batch):
             chunk, w = pad_samples(
                 samples[s0 : s0 + batch], 1,
                 total=batch if len(samples) > batch else None,
             )
-            keys, counts, n_unique, c = jax.device_get(
-                kernel(jnp.asarray(chunk), jnp.asarray(w), capacity)
-            )
-            check_capacity(name, int(n_unique), capacity)
-            cold += float(c)
-            decode_pairs(keys, counts, noshare, share)
+            chunk = jnp.asarray(chunk.astype(np.int32))
+            w = jnp.asarray(w)
+            pending.append((kernel(chunk, w, cap), chunk, w, cap))
+            if len(pending) >= 4:
+                drain(pending.pop(0))
+        for entry in pending:
+            drain(entry)
         results.append(
             SampledRefResult(
                 name=name, noshare=noshare, share=share, cold=cold,

@@ -1,0 +1,67 @@
+"""Recorded-baseline persistence (runtime/baseline.py)."""
+
+import dataclasses
+
+from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.runtime.baseline import (
+    baseline_path,
+    load_baseline,
+    save_baseline,
+    state_from_json,
+    state_to_json,
+)
+from pluss_sampler_optimization_tpu.runtime.hist import PRIState
+
+
+def make_state():
+    st = PRIState(thread_num=2)
+    st.update_noshare(0, 5, 3.0)   # pow2-bins to 4
+    st.update_noshare(1, -1, 2.0)  # cold bin passes through
+    st.update_share(0, 3, 16513, 1.5)
+    return st
+
+
+def test_state_json_roundtrip():
+    st = make_state()
+    back = state_from_json(state_to_json(st))
+    assert back.noshare == st.noshare
+    assert back.share == st.share
+    assert back.thread_num == st.thread_num
+    assert back.bin_noshare == st.bin_noshare
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = MachineConfig()
+    st = make_state()
+    path = str(tmp_path / "gemm8.json.gz")
+    save_baseline("gemm", 8, m, 1.25, 1000, st, path=path)
+    doc = load_baseline("gemm", 8, m, path=path)
+    assert doc is not None
+    assert doc["serial_seconds"] == 1.25
+    assert doc["total_accesses"] == 1000
+    assert doc["state"].noshare == st.noshare
+    assert doc["state"].share == st.share
+
+
+def test_load_rejects_machine_mismatch(tmp_path):
+    m = MachineConfig()
+    path = str(tmp_path / "b.json.gz")
+    save_baseline("gemm", 8, m, 1.0, 10, make_state(), path=path)
+    other = MachineConfig(thread_num=3)
+    assert load_baseline("gemm", 8, other, path=path) is None
+    # cache_kb only parameterizes AET->MRC, not the recorded serial run
+    aet_only = MachineConfig(cache_kb=1024)
+    assert load_baseline("gemm", 8, aet_only, path=path) is not None
+
+
+def test_load_missing_returns_none(tmp_path):
+    assert load_baseline(
+        "gemm", 8, MachineConfig(), path=str(tmp_path / "absent.json.gz")
+    ) is None
+
+
+def test_baseline_path_encodes_machine():
+    m = MachineConfig()
+    assert baseline_path("gemm", 128, m).endswith("gemm128.json.gz")
+    odd = dataclasses.replace(m, thread_num=3)
+    assert "t3" in baseline_path("gemm", 128, odd)

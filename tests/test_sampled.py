@@ -152,3 +152,18 @@ def test_sampled_reuses_subset_of_dense():
         for h in r.share.values():
             for v in h:
                 assert v in dense_keys, (r.name, v)
+
+
+def test_sampled_capacity_overflow_recovers():
+    """A too-small unique-pair capacity must transparently regrow (the
+    pipelined drain checks each entry against its own dispatch
+    capacity), producing results identical to an ample capacity."""
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.4, seed=11)
+    _, small = run_sampled(gemm(16), machine, cfg, capacity=2)
+    _, big = run_sampled(gemm(16), machine, cfg, capacity=4096)
+    for a, b in zip(small, big):
+        assert a.name == b.name
+        assert a.noshare == b.noshare
+        assert a.share == b.share
+        assert a.cold == b.cold

@@ -75,3 +75,52 @@ def test_owner_matches_reference_formula():
         assert s.owner_tid(i) == tid_ref
         assert s.local_chunk_id(i) == math.floor(i / 16)
         assert s.chunk_pos(i) == i % 4
+
+
+def test_interleaved_order_key_matches_comparator():
+    """Sorting by interleaved_order_key reproduces the r10 priority
+    queue's pop order (Iteration::compare, src/iteration.rs:63-134):
+    cid, then in-chunk pos, then inner loop variables; tid never
+    compared."""
+    import functools
+
+    import numpy as np
+
+    from pluss_sampler_optimization_tpu.config import MachineConfig
+    from pluss_sampler_optimization_tpu.core.schedule import (
+        interleaved_order_key,
+    )
+    from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+    from pluss_sampler_optimization_tpu.models.gemm import gemm
+
+    def compare(sched, a, b):
+        # faithful port for one reference's samples (positive steps)
+        ca, cb = sched.local_chunk_id(a[0]), sched.local_chunk_id(b[0])
+        if ca != cb:
+            return -1 if ca < cb else 1
+        pa, pb = sched.chunk_pos(a[0]), sched.chunk_pos(b[0])
+        if pa != pb:
+            return -1 if pa < pb else 1
+        for x, y in zip(a[1:], b[1:]):
+            if x != y:
+                return -1 if x < y else 1
+        return 0
+
+    trace = ProgramTrace(gemm(13), MachineConfig())
+    nt = trace.nests[0]
+    rng = np.random.default_rng(0)
+    for ref_idx in (0, 3):  # C0 (2-deep), B0 (3-deep)
+        lv = int(nt.tables.ref_levels[ref_idx])
+        samples = np.stack(
+            [rng.integers(0, 13, size=60) for _ in range(lv + 1)], axis=1
+        )
+        samples = np.unique(samples, axis=0)
+        keys = interleaved_order_key(nt, ref_idx, samples)
+        by_key = samples[np.argsort(keys, kind="stable")]
+        by_cmp = sorted(
+            samples.tolist(),
+            key=functools.cmp_to_key(
+                lambda a, b: compare(nt.schedule, a, b)
+            ),
+        )
+        assert by_key.tolist() == by_cmp

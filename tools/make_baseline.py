@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Record a native serial-oracle baseline for bench.py.
+
+Runs the native C++ serial full-traversal sampler (the reference's
+accuracy/speed oracle re-implemented over the IR) on one model/size and
+stores its histograms plus measured wall time under `baselines/` (see
+runtime/baseline.py). One-time cost per config; the north-star GEMM
+N=4096 takes ~1 h of single-core time.
+
+    python tools/make_baseline.py --model gemm --n 4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gemm")
+    ap.add_argument("--n", type=int, required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # the oracle never needs a TPU
+
+    from pluss_sampler_optimization_tpu import MachineConfig
+    from pluss_sampler_optimization_tpu.models import REGISTRY
+    from pluss_sampler_optimization_tpu.native import run_serial_native
+    from pluss_sampler_optimization_tpu.runtime.baseline import save_baseline
+    from pluss_sampler_optimization_tpu.runtime.timing import flush_cache
+
+    machine = MachineConfig()
+    prog = REGISTRY[args.model](args.n)
+    flush_cache()  # the reference flushes before timing (pluss.cpp:71-94)
+    t0 = time.perf_counter()
+    res = run_serial_native(prog, machine)
+    secs = time.perf_counter() - t0
+    path = save_baseline(
+        args.model, args.n, machine, secs, res.total_accesses, res.state
+    )
+    print(f"{path}: {secs:.1f}s, {res.total_accesses} accesses")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
