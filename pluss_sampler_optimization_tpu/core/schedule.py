@@ -20,9 +20,14 @@ array engines need and the reference never materializes. Every function
 is plain integer arithmetic and works elementwise on Python ints, numpy
 arrays and traced jax arrays alike.
 
-Only the static schedule is implemented: the reference's dynamic-chunk
-surface is dead code in every live sampler (the Rust port leaves it
-`unimplemented!`, src/chunk_dispatcher.rs:34-69).
+Only the static schedule is implemented. The C++ dispatcher carries a
+FIFO dynamic-chunk arm (getNextChunk/hasNextChunk(false),
+pluss_utils.h:391-411) but no live sampler ever drives it (every
+generated walk calls getNextStaticChunk; the Rust port leaves the
+dynamic trait `unimplemented!`, src/chunk_dispatcher.rs:34-69) — and
+under the model's uniform interleaving, threads request chunks in tid
+order, so FIFO assignment would reproduce the round-robin static map
+anyway. It stays out by design.
 """
 
 from __future__ import annotations
@@ -130,9 +135,12 @@ def interleaved_order_key(nest_trace, ref_idx: int, samples):
     by sampler/sampled.py::draw_samples); returns (S,) int64 keys whose
     ascending order is the interleaved execution order.
     """
+    import numpy as np
+
     t = nest_trace.tables
     sched = nest_trace.schedule
     lv = int(t.ref_levels[ref_idx])
+    samples = np.asarray(samples).astype(np.int64)  # int32 wire format
     n0 = samples[:, 0]
     key = sched.local_index(n0)  # (cid, pos) collapsed, tid excluded
     for l in range(1, lv + 1):

@@ -42,7 +42,6 @@ from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
 from ..sampler.sampled import (
     SampledRefResult,
-    check_capacity,
     check_packed_ratios,
     classify_samples,
     decode_pairs,
@@ -135,17 +134,28 @@ def sampled_outputs_sharded(
         cold = 0.0
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
+        cap = capacity
         for s0 in range(0, len(samples), step):
             chunk, w = pad_samples(
                 samples[s0 : s0 + step], n_dev,
                 total=step if len(samples) > step else None,
             )
-            nh, c, keys, counts, n_unique = jax.device_get(
-                kernel(jnp.asarray(chunk.astype(np.int32)), jnp.asarray(w))
-            )
-            keys = keys.reshape(n_dev, capacity)
-            counts = counts.reshape(n_dev, capacity)
-            check_capacity(name, int(n_unique.max(initial=0)), capacity)
+            cj, wj = jnp.asarray(chunk.astype(np.int32)), jnp.asarray(w)
+            while True:
+                nh, c, keys, counts, n_unique = jax.device_get(
+                    kernel(cj, wj)
+                )
+                if int(n_unique.max(initial=0)) <= cap:
+                    break
+                # rare: more distinct pairs than per-device slots —
+                # rebuild this ref's kernel with a larger capacity
+                # rather than abort (mirrors sampler/sampled.py)
+                cap = max(cap * 4, int(n_unique.max(initial=0)))
+                kernel = _build_sharded_ref_kernel(
+                    nt, ri, mesh, cap, cfg.use_pallas_hist
+                )
+            keys = keys.reshape(n_dev, cap)
+            counts = counts.reshape(n_dev, cap)
             dense += nh
             cold += float(c)
             for d in range(n_dev):

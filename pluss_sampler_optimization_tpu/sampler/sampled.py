@@ -155,16 +155,6 @@ def pad_samples(
     return samples, w
 
 
-def check_capacity(name: str, n_unique_max: int, capacity: int) -> None:
-    """The fixed-capacity unique reduction drops pairs beyond capacity;
-    the host must reject such runs rather than undercount."""
-    if n_unique_max > capacity:
-        raise RuntimeError(
-            f"sampled ref {name}: unique (reuse,class) pairs "
-            f"{n_unique_max} exceed capacity {capacity}; raise capacity"
-        )
-
-
 def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
     """Fold device (packed key, count) pairs into host sparse hists."""
     for key, cnt in zip(keys.tolist(), counts.tolist()):
@@ -299,7 +289,8 @@ def sampled_outputs(
             while int(n_unique) > dispatch_cap:
                 # rare: more distinct (reuse, class) pairs than slots —
                 # recompile with a larger capacity rather than abort
-                cap = dispatch_cap = max(cap * 4, int(n_unique))
+                dispatch_cap = max(dispatch_cap * 4, int(n_unique))
+                cap = max(cap, dispatch_cap)
                 keys, counts, n_unique, c = jax.device_get(
                     kernel(chunk, w, dispatch_cap)
                 )

@@ -98,3 +98,23 @@ def test_dense_sharded_matches_unsharded(n_dev):
 def test_dense_sharded_rejects_bad_mesh():
     with pytest.raises(ValueError):
         run_dense_sharded(gemm(8), MACHINE, mesh=build_mesh(3))
+
+
+def test_sharded_capacity_overflow_recovers():
+    """The mesh path regrows per-device pair capacity like the
+    single-device engine instead of aborting."""
+    from pluss_sampler_optimization_tpu.config import SamplerConfig
+    from pluss_sampler_optimization_tpu.models import gemm
+    from pluss_sampler_optimization_tpu.parallel import (
+        build_mesh,
+        run_sampled_sharded,
+    )
+    from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+
+    cfg = SamplerConfig(ratio=0.4, seed=11)
+    mesh = build_mesh(devices=jax.devices()[:2])
+    _, small = run_sampled_sharded(gemm(16), MACHINE, cfg, mesh, capacity=2)
+    _, big = run_sampled(gemm(16), MACHINE, cfg, capacity=4096)
+    for a, b in zip(small, big):
+        assert a.name == b.name and a.noshare == b.noshare
+        assert a.share == b.share and a.cold == b.cold
