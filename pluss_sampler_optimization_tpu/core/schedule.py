@@ -140,7 +140,9 @@ def interleaved_order_key(nest_trace, ref_idx: int, samples):
     t = nest_trace.tables
     sched = nest_trace.schedule
     lv = int(t.ref_levels[ref_idx])
-    samples = np.asarray(samples).astype(np.int64)  # int32 wire format
+    # widen the int32 wire format before radix math; .astype keeps
+    # numpy arrays numpy and traced jax arrays traced
+    samples = samples.astype(np.int64)
     n0 = samples[:, 0]
     key = sched.local_index(n0)  # (cid, pos) collapsed, tid excluded
     for l in range(1, lv + 1):

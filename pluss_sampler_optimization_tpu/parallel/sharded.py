@@ -99,11 +99,12 @@ def _sharded_program_kernels(
     for k, nt in enumerate(trace.nests):
         for ri in range(nt.tables.n_refs):
             kernels.append(
-                (k, ri,
+                [k, ri,
                  _build_sharded_ref_kernel(
                      nt, ri, mesh, capacity, use_pallas_hist
-                 ))
-            )
+                 ),
+                 capacity]  # capacity travels with the kernel: a
+            )                # regrown kernel returns wider arrays
     return trace, kernels
 
 
@@ -125,7 +126,7 @@ def sampled_outputs_sharded(
     )
     results = []
     dense_noshare = []
-    for idx, (k, ri, kernel) in enumerate(kernels):
+    for idx, (k, ri, kernel, cap) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
         samples = draw_samples(nt, ri, cfg, seed=cfg.seed * 1000003 + idx)
@@ -134,7 +135,6 @@ def sampled_outputs_sharded(
         cold = 0.0
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
-        cap = capacity
         for s0 in range(0, len(samples), step):
             chunk, w = pad_samples(
                 samples[s0 : s0 + step], n_dev,
@@ -149,11 +149,14 @@ def sampled_outputs_sharded(
                     break
                 # rare: more distinct pairs than per-device slots —
                 # rebuild this ref's kernel with a larger capacity
-                # rather than abort (mirrors sampler/sampled.py)
+                # rather than abort (mirrors sampler/sampled.py), and
+                # retain it in the cached kernel list so the recovery
+                # is paid once, not on every later call
                 cap = max(cap * 4, int(n_unique.max(initial=0)))
                 kernel = _build_sharded_ref_kernel(
                     nt, ri, mesh, cap, cfg.use_pallas_hist
                 )
+                kernels[idx][2:] = [kernel, cap]
             keys = keys.reshape(n_dev, cap)
             counts = counts.reshape(n_dev, cap)
             dense += nh

@@ -73,23 +73,24 @@ def draw_samples(
     ]
     s = cfg.num_samples(tuple(trips))
     rng = np.random.default_rng(seed)
-    # Vectorized draw-until-s-unique: dedupe preserves first occurrence
-    # in draw order (truncation of the draw-ordered stream keeps the
-    # distribution identical to the reference's one-at-a-time redraw
-    # loop's sample *set* semantics, r10 :159-185).
-    out_keys = np.empty(0, dtype=np.int64)
-    while len(out_keys) < s:
-        need = s - len(out_keys)
+    # Draw-until-s-unique, matching the reference's one-at-a-time
+    # redraw loop's sample *set* semantics (r10 :159-185): accumulate
+    # uniques, then thin to exactly s with an unbiased random subset
+    # (the drawn set is exchangeable, so a uniform subset of it is
+    # itself a uniform s-subset of the space; truncating the *sorted*
+    # uniques would bias toward small keys).
+    uniq = np.empty(0, dtype=np.int64)
+    while len(uniq) < s:
+        need = s - len(uniq)
         batch_keys = rng.integers(0, highs[0], size=max(64, need + need // 8))
         for h in highs[1:]:
             batch_keys = batch_keys * h + rng.integers(
                 0, h, size=batch_keys.shape
             )
-        _, first_idx = np.unique(batch_keys, return_index=True)
-        fresh = batch_keys[np.sort(first_idx)]
-        if len(out_keys):
-            fresh = fresh[~np.isin(fresh, out_keys)]
-        out_keys = np.concatenate([out_keys, fresh])[:s]
+        uniq = np.union1d(uniq, batch_keys)  # sorted unique union
+    if len(uniq) > s:
+        uniq = rng.choice(uniq, size=s, replace=False)
+    out_keys = uniq
     cols = []
     for h in reversed(highs):
         out_keys, col = np.divmod(out_keys, h)
