@@ -118,6 +118,11 @@ def main(argv=None) -> int:
                     help="trace mode row limit")
     ap.add_argument("--mrc-out", default=None,
                     help="also write the MRC to this file")
+    ap.add_argument("--diff-against", default=None, metavar="ENGINE",
+                    help="run a second engine and fail unless its dumps "
+                    "are byte-identical (automates the reference's "
+                    "output.txt diff protocol; compare full-traversal "
+                    "engines with each other, or sampled with sharded)")
     ap.add_argument(
         "--runtime",
         choices=["v1", "v2"],
@@ -162,6 +167,19 @@ def main(argv=None) -> int:
             "--pallas-hist applies to --engine sharded only (other "
             "engines reduce exact sparse pairs, not binned histograms)"
         )
+    if args.diff_against:
+        if args.mode not in ("acc", "sample"):
+            raise SystemExit(
+                "--diff-against compares acc/sample dumps; it has no "
+                "meaning in speed or trace mode"
+            )
+        _ENGINES = ("oracle", "numpy", "native", "dense", "stream",
+                    "sampled", "sharded")
+        if args.diff_against not in _ENGINES:
+            raise SystemExit(
+                f"unknown --diff-against engine {args.diff_against!r} "
+                f"(have {', '.join(_ENGINES)})"
+            )
 
     if args.mode == "trace":
         # the reference's -DDEBUG access/reuse logs (runtime/debug.py)
@@ -204,34 +222,60 @@ def main(argv=None) -> int:
         )
         return 0
 
-    res, per_ref = _run_engine(engine, program, machine, args)
+    def result_lines(eng: str):
+        res, per_ref = _run_engine(eng, program, machine, args)
+        lines: list[str] = []
+        if args.mode == "sample" and per_ref is not None:
+            # per-ref dumps (r10 prints each per-ref hist, :3277-3293)
+            lines += [
+                f"ref {r.name}: {r.n_samples} samples, cold {r.cold:g}"
+                for r in per_ref
+            ]
+        lines += report.noshare_dump(res.state)
+        lines += report.share_dump(res.state)
+        if args.r10:
+            if per_ref is None:
+                raise SystemExit("--r10 needs a sampled engine (sample mode)")
+            from .runtime.cri import r10_distribute
 
-    if args.mode == "sample" and per_ref is not None:
-        # per-ref dumps (r10 prints each per-ref histogram, :3277-3293)
-        for r in per_ref:
-            print(f"ref {r.name}: {r.n_samples} samples, cold {r.cold:g}")
+            rih, per_ref_hists = r10_distribute(per_ref, machine.thread_num)
+            for name, h in per_ref_hists.items():
+                lines += report.histogram_lines(name, h)
+        else:
+            rih = cri_distribute(
+                res.state, machine.thread_num, machine.thread_num
+            )
+        lines += report.rih_dump(rih)
+        mrc = aet_mrc(rih, machine)
+        lines += report.mrc_lines(mrc)
+        label = "samples" if per_ref is not None else "accesses"
+        lines.append(f"max iteration count: {res.total_accesses} {label}")
+        return lines, mrc
 
-    report.emit(report.noshare_dump(res.state))
-    report.emit(report.share_dump(res.state))
-    if args.r10:
-        if per_ref is None:
-            raise SystemExit("--r10 needs a sampled engine (sample mode)")
-        from .runtime.cri import r10_distribute
-
-        rih, per_ref_hists = r10_distribute(per_ref, machine.thread_num)
-        for name, h in per_ref_hists.items():
-            report.emit(report.histogram_lines(name, h))
-    else:
-        rih = cri_distribute(
-            res.state, machine.thread_num, machine.thread_num
-        )
-    report.emit(report.rih_dump(rih))
-    mrc = aet_mrc(rih, machine)
-    report.emit(report.mrc_lines(mrc))
-    label = "samples" if per_ref is not None else "accesses"
-    print(f"max iteration count: {res.total_accesses} {label}")
+    lines, mrc = result_lines(engine)
+    report.emit(lines)
     if args.mrc_out:
         report.write_mrc_to_file(mrc, args.mrc_out)
+
+    if args.diff_against:
+        # the reference's acc protocol appends each implementation's
+        # dumps to output.txt for manual inspection (run.sh:3-12,
+        # README.md:10-12); this automates the comparison
+        other_lines, _ = result_lines(args.diff_against)
+        if lines != other_lines:
+            import difflib
+
+            sys.stdout.writelines(
+                difflib.unified_diff(
+                    [l + "\n" for l in other_lines],
+                    [l + "\n" for l in lines],
+                    fromfile=args.diff_against,
+                    tofile=engine,
+                )
+            )
+            print(f"acc dumps DIFFER: {engine} vs {args.diff_against}")
+            return 1
+        print(f"acc dumps identical: {engine} vs {args.diff_against}")
     return 0
 
 

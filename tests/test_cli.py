@@ -68,3 +68,27 @@ def test_all_models_build(capsys):
 def test_unknown_engine():
     with pytest.raises(SystemExit):
         main(["acc", "--engine", "bogus"])
+
+
+def test_diff_against_identical(capsys):
+    assert main(["acc", "--model", "gemm", "--n", "12", "--engine",
+                 "dense", "--diff-against", "oracle"]) == 0
+    assert "acc dumps identical" in capsys.readouterr().out
+
+
+def test_diff_against_engine_pairs(capsys):
+    # sampled == sharded (same draws), dense == stream (same traversal)
+    assert main(["sample", "--n", "16", "--engine", "sampled",
+                 "--diff-against", "sharded", "--ratio", "0.2"]) == 0
+    capsys.readouterr()
+    assert main(["acc", "--n", "12", "--engine", "dense",
+                 "--diff-against", "stream"]) == 0
+    capsys.readouterr()
+
+
+def test_diff_against_mismatch(capsys):
+    # a sampled run cannot reproduce the full traversal's dumps
+    assert main(["acc", "--n", "16", "--engine", "sampled",
+                 "--diff-against", "dense", "--ratio", "0.2"]) == 1
+    out = capsys.readouterr().out
+    assert "acc dumps DIFFER" in out and "---" in out
