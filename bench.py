@@ -109,7 +109,10 @@ def main() -> int:
     from pluss_sampler_optimization_tpu.models.gemm import gemm
     from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc, mrc_l1_error
     from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
-    from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        run_sampled,
+        warmup,
+    )
 
     machine = MachineConfig()
     prog = gemm(args.n)
@@ -120,7 +123,7 @@ def main() -> int:
 
     # warm-up: compiles every per-ref kernel at the run's batch shapes
     t0 = time.perf_counter()
-    run_sampled(prog, machine, cfg)
+    warmup(prog, machine, cfg)
     warmup_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     state, results = run_sampled(prog, machine, cfg)

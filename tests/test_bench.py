@@ -1,0 +1,32 @@
+"""bench.py must always print one parseable JSON line (the driver
+consumes it unattended)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_json_line():
+    # a cached successful probe would bypass --device-timeout and let
+    # the subprocess block on a stalled accelerator tunnel
+    marker = os.path.join(REPO, ".jax_cache", "accel_ok")
+    if os.path.exists(marker):
+        os.remove(marker)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--n", "64", "--device-timeout", "1"],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [
+        l for l in proc.stdout.splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, proc.stdout[-2000:]
+    doc = json.loads(json_lines[0])
+    assert doc["unit"] == "samples/s/chip"
+    assert doc["value"] > 0
+    assert doc["vs_baseline"] > 0  # native baseline must have run
+    assert doc["extra"]["mrc_l1_err"] < 0.05
