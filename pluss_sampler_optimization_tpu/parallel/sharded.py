@@ -41,11 +41,14 @@ from ..ops.histogram import N_EXP_BINS, exp_hist, fixed_k_unique
 from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
 from ..sampler.sampled import (
+    DEFAULT_BATCH,
+    DEFAULT_CAPACITY,
     SampledRefResult,
     check_packed_ratios,
     classify_samples,
     decode_pairs,
-    draw_samples,
+    decode_sample_keys,
+    draw_sample_keys,
     fold_results,
     pad_samples,
 )
@@ -113,8 +116,8 @@ def sampled_outputs_sharded(
     machine: MachineConfig,
     cfg: SamplerConfig | None = None,
     mesh: jax.sharding.Mesh | None = None,
-    batch: int = 1 << 20,
-    capacity: int = 256,
+    batch: int = DEFAULT_BATCH,
+    capacity: int = DEFAULT_CAPACITY,
 ):
     """Sharded sampled engine -> per-ref SampledRefResult (exact) plus
     the psum'd dense noshare histograms (per ref, for observability)."""
@@ -129,16 +132,21 @@ def sampled_outputs_sharded(
     for idx, (k, ri, kernel, cap) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
-        samples = draw_samples(nt, ri, cfg, seed=cfg.seed * 1000003 + idx)
+        # key form until dispatch: a large run holds 1/3 the memory
+        # (see draw_sample_keys)
+        keys_all, highs = draw_sample_keys(
+            nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+        )
+        n_samples = len(keys_all)
         noshare: dict[int, float] = {}
         share: dict[int, dict[int, float]] = {}
         cold = 0.0
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
-        for s0 in range(0, len(samples), step):
+        for s0 in range(0, n_samples, step):
             chunk, w = pad_samples(
-                samples[s0 : s0 + step], n_dev,
-                total=step if len(samples) > step else None,
+                decode_sample_keys(keys_all[s0 : s0 + step], highs), n_dev,
+                total=step if n_samples > step else None,
             )
             cj, wj = jnp.asarray(chunk.astype(np.int32)), jnp.asarray(w)
             while True:
@@ -166,7 +174,7 @@ def sampled_outputs_sharded(
         results.append(
             SampledRefResult(
                 name=name, noshare=noshare, share=share, cold=cold,
-                n_samples=len(samples),
+                n_samples=n_samples,
             )
         )
         dense_noshare.append(dense)

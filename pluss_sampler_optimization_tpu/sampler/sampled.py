@@ -50,6 +50,11 @@ from .nextuse import INF, next_use_candidates
 _RATIO_SLOTS = 16  # packed key = reuse * 16 + (ratio | noshare-slot 15)
 _NOSHARE_SLOT = _RATIO_SLOTS - 1
 
+# One source of truth for the dispatch geometry: warmup() compiles at
+# these exact values, so callers overriding one site must override both.
+DEFAULT_BATCH = 1 << 20
+DEFAULT_CAPACITY = 256
+
 
 @dataclasses.dataclass
 class SampledRefResult:
@@ -62,16 +67,25 @@ class SampledRefResult:
     n_samples: int
 
 
-def draw_samples(
-    nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig, seed: int
-) -> np.ndarray:
-    """Dedup'd uniform normalized iteration tuples, shape (S, depth)."""
+def _sample_highs(nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig):
     lv = int(nest_trace.tables.ref_levels[ref_idx])
     trips = [nest_trace.nest.loops[l].trip for l in range(lv + 1)]
     highs = [
         max(1, t - 1 if cfg.exclude_last_iteration else t) for t in trips
     ]
-    s = cfg.num_samples(tuple(trips))
+    return highs, cfg.num_samples(tuple(trips))
+
+
+def draw_sample_keys(
+    nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig, seed: int
+):
+    """Dedup'd uniform samples as mixed-radix keys, shape (S,) int64.
+
+    The key form is what large runs hold in memory (a GEMM N=8192 ref
+    draws ~5.5e8 samples: 4.4 GB of keys vs 13 GB of decoded tuples);
+    decode_sample_keys expands one batch at a time at dispatch.
+    """
+    highs, s = _sample_highs(nest_trace, ref_idx, cfg)
     rng = np.random.default_rng(seed)
     # Draw-until-s-unique, matching the reference's one-at-a-time
     # redraw loop's sample *set* semantics (r10 :159-185): accumulate
@@ -90,12 +104,24 @@ def draw_samples(
         uniq = np.union1d(uniq, batch_keys)  # sorted unique union
     if len(uniq) > s:
         uniq = rng.choice(uniq, size=s, replace=False)
-    out_keys = uniq
+    return uniq, highs
+
+
+def decode_sample_keys(keys: np.ndarray, highs) -> np.ndarray:
+    """Mixed-radix keys -> normalized iteration tuples (len(keys), depth)."""
     cols = []
     for h in reversed(highs):
-        out_keys, col = np.divmod(out_keys, h)
+        keys, col = np.divmod(keys, h)
         cols.append(col)
     return np.stack(cols[::-1], axis=1).astype(np.int64)
+
+
+def draw_samples(
+    nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig, seed: int
+) -> np.ndarray:
+    """Dedup'd uniform normalized iteration tuples, shape (S, depth)."""
+    keys, highs = draw_sample_keys(nest_trace, ref_idx, cfg, seed)
+    return decode_sample_keys(keys, highs)
 
 
 def check_packed_ratios(nt: NestTrace) -> None:
@@ -267,8 +293,8 @@ def warmup(
     program: Program,
     machine: MachineConfig,
     cfg: SamplerConfig | None = None,
-    batch: int = 1 << 20,
-    capacity: int = 256,
+    batch: int = DEFAULT_BATCH,
+    capacity: int = DEFAULT_CAPACITY,
 ) -> None:
     """Compile every per-ref kernel at the exact shapes a subsequent
     sampled_outputs run will use, on dummy batches sized through the
@@ -297,8 +323,8 @@ def sampled_outputs(
     program: Program,
     machine: MachineConfig,
     cfg: SamplerConfig,
-    batch: int = 1 << 20,
-    capacity: int = 256,
+    batch: int = DEFAULT_BATCH,
+    capacity: int = DEFAULT_CAPACITY,
 ):
     """Run the sampled engine; one SampledRefResult per reference."""
     trace, kernels = _program_kernels(program, machine)
@@ -306,7 +332,10 @@ def sampled_outputs(
     for idx, (k, ri, kernel) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
-        samples = draw_samples(nt, ri, cfg, seed=cfg.seed * 1000003 + idx)
+        keys_all, highs = draw_sample_keys(
+            nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+        )
+        n_samples = len(keys_all)
         noshare: dict[int, float] = {}
         share: dict[int, dict[int, float]] = {}
         cold = 0.0
@@ -328,10 +357,10 @@ def sampled_outputs(
             cold += float(c)
             decode_pairs(keys, counts, noshare, share)
 
-        for s0 in range(0, len(samples), batch):
+        for s0 in range(0, n_samples, batch):
             chunk, w = pad_samples(
-                samples[s0 : s0 + batch], 1,
-                total=batch if len(samples) > batch else None,
+                decode_sample_keys(keys_all[s0 : s0 + batch], highs), 1,
+                total=batch if n_samples > batch else None,
             )
             chunk = jnp.asarray(chunk.astype(np.int32))
             w = jnp.asarray(w)
@@ -343,7 +372,7 @@ def sampled_outputs(
         results.append(
             SampledRefResult(
                 name=name, noshare=noshare, share=share, cold=cold,
-                n_samples=len(samples),
+                n_samples=n_samples,
             )
         )
     return results

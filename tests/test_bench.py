@@ -7,14 +7,15 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import bench  # noqa: E402  (repo-root module)
 
 
 def test_bench_emits_json_line():
     # a cached successful probe would bypass --device-timeout and let
     # the subprocess block on a stalled accelerator tunnel
-    marker = os.path.join(REPO, ".jax_cache", "accel_ok")
-    if os.path.exists(marker):
-        os.remove(marker)
+    if os.path.exists(bench._PROBE_MARKER):
+        os.remove(bench._PROBE_MARKER)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--n", "64", "--device-timeout", "1"],
