@@ -309,8 +309,7 @@ def warmup(
     for k, ri, kernel in kernels:
         nt = trace.nests[k]
         lv = int(nt.tables.ref_levels[ri])
-        trips = [nt.nest.loops[l].trip for l in range(lv + 1)]
-        s = cfg.num_samples(tuple(trips))
+        _, s = _sample_highs(nt, ri, cfg)
         rows = np.zeros((min(s, batch), lv + 1), dtype=np.int64)
         chunk, w = pad_samples(rows, 1, total=batch if s > batch else None)
         jax.block_until_ready(
