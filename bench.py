@@ -38,14 +38,16 @@ _PROBE_TTL_S = 3600.0
 
 
 def probe_accelerator(timeout_s: float) -> tuple[bool, float]:
-    """Check in a subprocess that the default JAX backend initializes.
+    """Check in a subprocess that the default JAX backend can COMPILE.
 
     The accelerator may sit behind a tunnel whose setup can stall
-    indefinitely; a hung `jax.devices()` would otherwise take the whole
-    benchmark down with it. Probing in a child process keeps the parent
-    free to pin JAX_PLATFORMS=cpu before it ever imports jax. A
-    successful probe is cached for an hour so healthy repeat runs skip
-    the duplicate backend init. Returns (accelerator_ok, probe_seconds).
+    indefinitely — and `jax.devices()` succeeding does not imply the
+    compile service behind it is up (a dead remote-compile endpoint
+    once failed 25 minutes into warm-up). So the probe runs a tiny
+    jit end-to-end; a hang hits the subprocess timeout and the parent
+    pins JAX_PLATFORMS=cpu before it ever imports jax. A successful
+    probe is cached for an hour so healthy repeat runs skip the
+    duplicate backend init. Returns (accelerator_ok, probe_seconds).
     """
     try:
         if time.time() - os.path.getmtime(_PROBE_MARKER) < _PROBE_TTL_S:
@@ -56,7 +58,9 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, float]:
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
+             "import jax, jax.numpy as jnp; "
+             "jax.jit(lambda x: x @ x)(jnp.ones((128, 128)))"
+             ".block_until_ready(); print('ok')"],
             timeout=timeout_s, capture_output=True, text=True,
         )
         ok = proc.returncode == 0 and "ok" in proc.stdout

@@ -26,15 +26,20 @@ import sys
 
 
 def _build_model(name: str, n: int, tsteps: int):
+    import inspect
+
     from .models import REGISTRY
 
     if name not in REGISTRY:
         raise SystemExit(
             f"unknown model {name!r} (have {', '.join(sorted(REGISTRY))})"
         )
-    if name == "jacobi-2d":
-        return REGISTRY[name](n, tsteps=tsteps)
-    return REGISTRY[name](n)
+    fn = REGISTRY[name]
+    if "tsteps" in inspect.signature(fn).parameters:
+        return fn(n, tsteps=tsteps)
+    if tsteps != 1:
+        raise SystemExit(f"model {name!r} has no time-step dimension")
+    return fn(n)
 
 
 def _run_engine(engine: str, program, machine, args):
@@ -93,10 +98,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
     ap.add_argument("mode", choices=["acc", "speed", "sample", "trace"])
     ap.add_argument("--model", default="gemm",
-                    help="gemm | 2mm | 3mm | syrk | jacobi-2d | mvt | "
-                    "bicg | gesummv")
+                    help="gemm | 2mm | 3mm | syrk | jacobi-2d | mvt | bicg "
+                    "| gesummv | atax | gemver | doitgen | fdtd-2d | heat-3d")
     ap.add_argument("--n", type=int, default=128)
-    ap.add_argument("--tsteps", type=int, default=1, help="jacobi-2d only")
+    ap.add_argument("--tsteps", type=int, default=1,
+                    help="time steps (jacobi-2d, fdtd-2d, heat-3d)")
     ap.add_argument(
         "--engine",
         default=None,

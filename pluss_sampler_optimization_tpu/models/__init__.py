@@ -5,9 +5,14 @@ generated samplers (or the analogous PolyBench kernel for benchmarks the
 reference's BASELINE configs name but ship no generated sampler for).
 """
 
+from .atax import atax
 from .bicg import bicg
+from .doitgen import doitgen
+from .fdtd2d import fdtd2d
 from .gemm import gemm
+from .gemver import gemver
 from .gesummv import gesummv
+from .heat3d import heat3d
 from .jacobi2d import jacobi2d
 from .mm2 import mm2
 from .mm3 import mm3
@@ -23,9 +28,15 @@ REGISTRY = {
     "mvt": mvt,
     "bicg": bicg,
     "gesummv": gesummv,
+    "atax": atax,
+    "gemver": gemver,
+    "doitgen": doitgen,
+    "fdtd-2d": fdtd2d,
+    "heat-3d": heat3d,
 }
 
 __all__ = [
     "gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "mvt", "bicg",
-    "gesummv", "REGISTRY",
+    "gesummv", "atax", "gemver", "doitgen", "fdtd2d", "heat3d",
+    "REGISTRY",
 ]

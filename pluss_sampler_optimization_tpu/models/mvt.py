@@ -15,8 +15,9 @@ Coverage this model adds over gemm/2mm/3mm/syrk:
 
 - a *transposed* access A[j][i] (flat = j*N + i, coefficient on the
   inner variable larger than on the parallel one) — the closed-form
-  next-use factoring (sampler/nextuse.py::_ref_row_col) must pick the
-  inner variable as the row term;
+  next-use band enumeration (sampler/nextuse.py::_ref_vars orders
+  coefficients descending) must treat the inner variable as the
+  large-stride term;
 - share references in a 2-deep nest (y_1/y_2 omit i). Their carried
   reuse across consecutive parallel iterations spans one inner loop of
   body accesses (~4N); the generated-code threshold family

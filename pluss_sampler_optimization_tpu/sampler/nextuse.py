@@ -175,46 +175,44 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
     return out
 
 
-def _ref_row_col(nt: NestTrace, ref_idx: int):
-    """Factor a ref's flat map as M*u + v + d (levels of u/v or None)."""
+def _ref_vars(nt: NestTrace, ref_idx: int):
+    """Nonzero (level, coeff) terms of a ref's flat map, coeff descending.
+
+    The row-major PolyBench family always yields positive coefficients
+    (strides n^2, n, 1 ...); negative strides have no closed-form band
+    enumeration here and raise.
+    """
     t = nt.tables
     lv = int(t.ref_levels[ref_idx])
     nz = [(l, int(t.ref_coeffs[ref_idx][l])) for l in range(lv + 1)
           if int(t.ref_coeffs[ref_idx][l]) != 0]
-    d = int(t.ref_consts[ref_idx])
-    if len(nz) == 0:
-        return None, None, 0, d
-    if len(nz) == 1:
-        l, c = nz[0]
-        if c == 1:
-            return None, l, 0, d
-        return l, None, c, d
-    if len(nz) != 2:
-        raise NotImplementedError(
-            f"ref {t.ref_names[ref_idx]}: >2 index variables unsupported"
-        )
-    (la, ca), (lb, cb) = nz
-    if abs(ca) < abs(cb):
-        (la, ca), (lb, cb) = (lb, cb), (la, ca)
-    if cb != 1 or ca <= 0:
-        raise NotImplementedError(
-            f"ref {t.ref_names[ref_idx]}: flat map must factor as M*u + v + d"
-        )
-    return la, lb, ca, d
+    for _, c in nz:
+        if c <= 0:
+            raise NotImplementedError(
+                f"ref {t.ref_names[ref_idx]}: negative stride unsupported"
+            )
+    nz.sort(key=lambda p: -p[1])
+    return nz, int(t.ref_consts[ref_idx])
 
 
 def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
     """Min position > p0 where `sink_idx` touches `line` on thread tid.
 
-    Vectorized over samples (tid, p0, line are arrays). Enumerates the
-    static candidate grid and reduces with min_position_after.
+    Vectorized over samples (tid, p0, line are arrays). The flat map
+    sum_i c_i*x_i + d must land in the line's band [line*W, line*W + W);
+    candidates for the x_i are enumerated recursively, largest stride
+    first: each head value divides the residual band, the innermost
+    unit-stride variable takes an exact W-wide window, and a trailing
+    band check covers every other terminal. The candidate count is a
+    static O(1) bound per level, so the whole solve stays a fixed
+    vector program. Reduces with min_position_after.
     """
     t = nt.tables
     machine = nt.machine
     sched = nt.schedule
     lv = int(t.ref_levels[sink_idx])
     W = machine.lines_per_element_block
-    big_l, small_l, M, d = _ref_row_col(nt, sink_idx)
+    nz, d = _ref_vars(nt, sink_idx)
     lo = line * W - d  # target flat-offset band [lo, lo+W)
 
     # per-sample local-count bound for free level 0
@@ -237,56 +235,50 @@ def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
             return _LevelSpec.fix(sched.local_index(n), ok)
         return _LevelSpec.fix(n, ok)
 
-    def assemble(fixed_vals):
-        """fixed_vals: {level: (value, valid)} -> specs list."""
+    def assemble(fixed_vals, ok):
+        """fixed_vals: {level: value}; `ok` ANDs into every fixed spec."""
         specs = []
         for l in range(lv + 1):
             if l in fixed_vals:
-                value, ok = fixed_vals[l]
-                specs.append(spec_from_value(l, value, ok))
+                specs.append(spec_from_value(l, fixed_vals[l], ok))
             else:
                 specs.append(_LevelSpec.free(level_bound(l)))
         return specs
 
+    def value_span(l):
+        lp = nt.nest.loops[l]
+        return min(lp.start, lp.last), max(lp.start, lp.last)
+
     best = jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
     true_ = jnp.ones(jnp.shape(p0), dtype=bool)
 
-    if big_l is None and small_l is None:
-        ok = (lo <= 0) & (lo > -W)  # flat == d lands in the band
-        p = min_position_after(nt, sink_idx, p0, assemble({}))
-        return jnp.where(ok, p, INF)
+    def emit(fixed_vals, ok):
+        nonlocal best
+        p = min_position_after(nt, sink_idx, p0, assemble(fixed_vals, ok))
+        if not fixed_vals:  # constant ref: no spec carries the validity
+            p = jnp.where(ok, p, INF)
+        best = jnp.minimum(best, p)
 
-    if big_l is None:
-        # v in [lo, lo+W)
-        for k in range(W):
-            v = lo + k
-            specs = assemble({small_l: (v, true_)})
-            best = jnp.minimum(best, min_position_after(nt, sink_idx, p0, specs))
-        return best
+    def recurse(vars_left, lo_cur, ok, fixed_vals):
+        if not vars_left:
+            # remaining contribution is 0: valid iff 0 in [lo_cur, lo_cur+W)
+            emit(fixed_vals, ok & (lo_cur <= 0) & (lo_cur > -W))
+            return
+        if len(vars_left) == 1 and vars_left[0][1] == 1:
+            l, _ = vars_left[0]
+            for k in range(W):  # exact window, band membership by construction
+                emit({**fixed_vals, l: lo_cur + k}, ok)
+            return
+        (l, c), rest = vars_left[0], vars_left[1:]
+        r_min = sum(cr * value_span(lr)[0] for lr, cr in rest)
+        r_max = sum(cr * value_span(lr)[1] for lr, cr in rest)
+        u_min = _cdiv(lo_cur - r_max, c)
+        u_max = (lo_cur + W - 1 - r_min) // c
+        n_u = (W - 1 + (r_max - r_min)) // c + 2  # static bound
+        for iu in range(n_u):
+            u = u_min + iu
+            recurse(rest, lo_cur - c * u, ok & (u <= u_max),
+                    {**fixed_vals, l: u})
 
-    # big var present: u candidates
-    sl = nt.nest.loops[small_l] if small_l is not None else None
-    if sl is not None:
-        s_min = min(sl.start, sl.last)
-        s_max = max(sl.start, sl.last)
-    else:
-        s_min = s_max = 0
-    u_min = _cdiv(lo - s_max, M)
-    u_max = (lo + W - 1 - s_min) // M
-    n_u = int((W - 1 + (s_max - s_min)) // M) + 2  # static bound
-
-    for iu in range(n_u):
-        u = u_min + iu
-        u_ok = u <= u_max
-        if small_l is None:
-            band_ok = (M * u >= lo) & (M * u < lo + W)
-            specs = assemble({big_l: (u, u_ok & band_ok)})
-            best = jnp.minimum(best, min_position_after(nt, sink_idx, p0, specs))
-        else:
-            for k in range(W):
-                v = lo + k - M * u
-                specs = assemble({big_l: (u, u_ok), small_l: (v, u_ok)})
-                best = jnp.minimum(
-                    best, min_position_after(nt, sink_idx, p0, specs)
-                )
+    recurse(nz, lo, true_, {})
     return best

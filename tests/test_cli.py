@@ -57,12 +57,27 @@ def test_sample_mode_writes_mrc(tmp_path, capsys):
 
 
 def test_all_models_build(capsys):
-    for model in ["gemm", "2mm", "3mm", "syrk", "jacobi-2d"]:
+    from pluss_sampler_optimization_tpu.models import REGISTRY
+
+    for model in REGISTRY:
         out = _dump(
             capsys,
             ["acc", "--model", model, "--n", "8", "--engine", "oracle"],
         )
         assert "miss ratio" in out
+
+
+def test_tsteps_flag(capsys):
+    # reaches every time-stepped model; rejected where it has no meaning
+    for model in ["jacobi-2d", "fdtd-2d", "heat-3d"]:
+        out = _dump(
+            capsys,
+            ["acc", "--model", model, "--n", "6", "--tsteps", "2",
+             "--engine", "oracle"],
+        )
+        assert "miss ratio" in out
+    with pytest.raises(SystemExit):
+        main(["acc", "--model", "gemm", "--n", "8", "--tsteps", "2"])
 
 
 def test_unknown_engine():

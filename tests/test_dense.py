@@ -4,9 +4,14 @@ import pytest
 
 from pluss_sampler_optimization_tpu.config import MachineConfig
 from pluss_sampler_optimization_tpu.models import (
+    atax,
     bicg,
+    doitgen,
+    fdtd2d,
     gemm,
+    gemver,
     gesummv,
+    heat3d,
     jacobi2d,
     mm2,
     mm3,
@@ -28,6 +33,11 @@ PROGRAMS = [
     mvt(16),
     bicg(13, 17),
     gesummv(16),
+    atax(13, 9),
+    gemver(12),
+    doitgen(3, 4, 8),
+    fdtd2d(10, 9, tsteps=2),
+    heat3d(9),
 ]
 
 

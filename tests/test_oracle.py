@@ -4,9 +4,14 @@ import pytest
 
 from pluss_sampler_optimization_tpu.config import MachineConfig
 from pluss_sampler_optimization_tpu.models import (
+    atax,
     bicg,
+    doitgen,
+    fdtd2d,
     gemm,
+    gemver,
     gesummv,
+    heat3d,
     jacobi2d,
     mm2,
     mm3,
@@ -27,6 +32,11 @@ PROGRAMS = [
     mvt(16),
     bicg(13, 17),  # rectangular + short last chunk
     gesummv(16),
+    atax(13, 9),  # interchanged y-update, written share tmp
+    gemver(12),  # four nests of mixed depth over one shared A
+    doitgen(3, 4, 8),  # collapsed (r,q) parallel loop
+    fdtd2d(10, 9, tsteps=2),  # constant ref, boundary starts
+    heat3d(9),  # 3-coefficient refs
 ]
 
 

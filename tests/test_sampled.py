@@ -13,9 +13,14 @@ import pytest
 from pluss_sampler_optimization_tpu.config import MachineConfig, SamplerConfig
 from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
 from pluss_sampler_optimization_tpu.models import (
+    atax,
     bicg,
+    doitgen,
+    fdtd2d,
     gemm,
+    gemver,
     gesummv,
+    heat3d,
     jacobi2d,
     mm2,
     mvt,
@@ -60,6 +65,11 @@ PROGRAMS = [
     (mvt(10), None),  # transposed A[j][i]
     (bicg(9, 11), None),  # 1-deep nest + written share refs
     (gesummv(10), None),  # post-slot level-0 refs
+    (atax(9, 11), None),  # interchanged transposed y-update
+    (gemver(10), None),  # mixed-depth nests over shared A
+    (doitgen(3, 4, 5), None),  # collapsed parallel loop
+    (fdtd2d(6, 7), None),  # constant ref (no loop variable)
+    (heat3d(7), None),  # 3-coefficient flat maps
 ]
 
 

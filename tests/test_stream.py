@@ -3,8 +3,12 @@
 import pytest
 
 from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.models.atax import atax
+from pluss_sampler_optimization_tpu.models.doitgen import doitgen
+from pluss_sampler_optimization_tpu.models.fdtd2d import fdtd2d
 from pluss_sampler_optimization_tpu.models.gemm import gemm
 from pluss_sampler_optimization_tpu.models.gesummv import gesummv
+from pluss_sampler_optimization_tpu.models.heat3d import heat3d
 from pluss_sampler_optimization_tpu.models.jacobi2d import jacobi2d
 from pluss_sampler_optimization_tpu.models.mm2 import mm2
 from pluss_sampler_optimization_tpu.models.mvt import mvt
@@ -59,3 +63,9 @@ def test_stream_matches_dense_mvt_gesummv():
     # transposed access + post-slot level-0 refs under the scan carry
     for prog in (mvt(16), gesummv(16)):
         _results_equal(run_dense(prog, MACHINE), run_stream(prog, MACHINE, 3))
+
+
+def test_stream_matches_dense_new_models():
+    # 3-coefficient stencil + constant ref + collapsed parallel loop
+    for prog in (heat3d(7), fdtd2d(6, 7), doitgen(3, 4, 5), atax(9, 11)):
+        _results_equal(run_dense(prog, MACHINE), run_stream(prog, MACHINE, 2))
