@@ -50,7 +50,7 @@ from ..sampler.sampled import (
     decode_sample_keys,
     draw_sample_keys,
     fold_results,
-    pad_samples,
+    pad_keys,
 )
 from .mesh import build_mesh
 
@@ -68,10 +68,14 @@ def _build_sharded_ref_kernel(
     else:
         _hist_fn = exp_hist
 
-    def local_fn(samples, weights):
-        samples = samples.astype(jnp.int64)  # int32 on the wire
+    def local_fn(sample_keys, n_valid, highs):
+        # int64 mixed-radix keys on the wire (8 bytes/sample); decode
+        # and the padding weight mask both happen device-side
+        samples = decode_sample_keys(sample_keys, highs)
         packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
-        w = weights.astype(bool)
+        local_b = sample_keys.shape[0]
+        base = jax.lax.axis_index(axis).astype(jnp.int64) * local_b
+        w = base + jnp.arange(local_b, dtype=jnp.int64) < n_valid
         # scalable output: dense pow2 noshare histogram, psum over ICI
         nosh_hist = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
         nosh_hist = jax.lax.psum(nosh_hist, axis)
@@ -80,13 +84,15 @@ def _build_sharded_ref_kernel(
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
         return nosh_hist, cold, keys, counts, n_unique[None]
 
-    sharded = jax.shard_map(
-        local_fn,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis)),
-        out_specs=(P(), P(), P(axis), P(axis), P(axis)),
-    )
-    return jax.jit(sharded)
+    def entry(sample_keys, n_valid, highs: tuple):
+        return jax.shard_map(
+            functools.partial(local_fn, highs=highs),
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(P(), P(), P(axis), P(axis), P(axis)),
+        )(sample_keys, n_valid)
+
+    return jax.jit(entry, static_argnames=("highs",))
 
 
 @functools.lru_cache(maxsize=16)
@@ -144,14 +150,14 @@ def sampled_outputs_sharded(
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
         for s0 in range(0, n_samples, step):
-            chunk, w = pad_samples(
-                decode_sample_keys(keys_all[s0 : s0 + step], highs), n_dev,
+            chunk, n_valid = pad_keys(
+                keys_all[s0 : s0 + step], n_dev,
                 total=step if n_samples > step else None,
             )
-            cj, wj = jnp.asarray(chunk.astype(np.int32)), jnp.asarray(w)
+            cj = jnp.asarray(chunk)
             while True:
                 nh, c, keys, counts, n_unique = jax.device_get(
-                    kernel(cj, wj)
+                    kernel(cj, n_valid, tuple(highs))
                 )
                 if int(n_unique.max(initial=0)) <= cap:
                     break

@@ -53,12 +53,15 @@ def _cdiv(a, b):
 @dataclasses.dataclass(frozen=True)
 class _LevelSpec:
     """Domain of one loop level in a candidate: fixed to a value (with a
-    validity mask) or free over [0, bound)."""
+    validity mask), an interval [lo, hi) of normalized indices, or free
+    over [0, bound)."""
 
     fixed: bool
     value: object = None  # jnp int64 array when fixed
     valid: object = None  # jnp bool array when fixed
     bound: object = None  # jnp/int upper bound when free
+    lo: object = None  # jnp int64 array when interval
+    hi: object = None  # jnp int64 array when interval (empty if hi<=lo)
 
     @staticmethod
     def free(bound):
@@ -68,10 +71,16 @@ class _LevelSpec:
     def fix(value, valid):
         return _LevelSpec(fixed=True, value=value, valid=valid)
 
+    @staticmethod
+    def interval(lo, hi):
+        return _LevelSpec(fixed=False, lo=lo, hi=hi)
+
     def min_val(self):
         """Smallest element, INF-marked when empty/invalid."""
         if self.fixed:
             return jnp.where(self.valid, self.value, INF)
+        if self.lo is not None:
+            return jnp.where(self.lo < self.hi, self.lo, INF)
         return jnp.zeros((), dtype=jnp.int64)
 
     def min_gt(self, x):
@@ -79,6 +88,9 @@ class _LevelSpec:
         if self.fixed:
             ok = self.valid & (self.value > x)
             return jnp.where(ok, self.value, INF)
+        if self.lo is not None:
+            nxt = jnp.maximum(self.lo, x + 1)
+            return jnp.where(nxt < self.hi, nxt, INF)
         nxt = jnp.maximum(jnp.int64(0), x + 1)
         return jnp.where(nxt < self.bound, nxt, INF)
 
@@ -87,6 +99,8 @@ class _LevelSpec:
         if self.fixed:
             ok = self.valid & (self.value == x)
             return jnp.where(ok, x, INF)
+        if self.lo is not None:
+            return jnp.where((x >= self.lo) & (x < self.hi), x, INF)
         return jnp.where((x >= 0) & (x < self.bound), x, INF)
 
     def min_scaled_gt(self, scale, x):
@@ -94,6 +108,9 @@ class _LevelSpec:
         if self.fixed:
             ok = self.valid & (self.value * scale > x)
             return jnp.where(ok, self.value, INF)
+        if self.lo is not None:
+            nxt = jnp.maximum(self.lo, x // scale + 1)
+            return jnp.where(nxt < self.hi, nxt, INF)
         nxt = jnp.maximum(jnp.int64(0), x // scale + 1)
         return jnp.where(nxt < self.bound, nxt, INF)
 
@@ -236,11 +253,19 @@ def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
         return _LevelSpec.fix(n, ok)
 
     def assemble(fixed_vals, ok):
-        """fixed_vals: {level: value}; `ok` ANDs into every fixed spec."""
+        """fixed_vals: {level: value or ('interval', n_lo, n_hi)};
+        `ok` ANDs into every fixed/interval spec."""
         specs = []
         for l in range(lv + 1):
             if l in fixed_vals:
-                specs.append(spec_from_value(l, fixed_vals[l], ok))
+                fv = fixed_vals[l]
+                if isinstance(fv, tuple) and fv[0] == "interval":
+                    _, n_lo, n_hi = fv
+                    specs.append(_LevelSpec.interval(
+                        n_lo, jnp.where(ok, n_hi, n_lo)
+                    ))
+                else:
+                    specs.append(spec_from_value(l, fv, ok))
             else:
                 specs.append(_LevelSpec.free(level_bound(l)))
         return specs
@@ -266,6 +291,17 @@ def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
             return
         if len(vars_left) == 1 and vars_left[0][1] == 1:
             l, _ = vars_left[0]
+            lp = nt.nest.loops[l]
+            if l != 0 and lp.step == 1:
+                # The W-wide value window [lo_cur, lo_cur+W) maps to one
+                # contiguous normalized-index interval: a single spec
+                # replaces W per-value candidates (band membership and
+                # trip clipping by construction). Level 0 is excluded
+                # because ownership chops its index range per thread.
+                n_lo = jnp.maximum(lo_cur - lp.start, 0)
+                n_hi = jnp.minimum(lo_cur - lp.start + W, lp.trip)
+                emit({**fixed_vals, l: ("interval", n_lo, n_hi)}, ok)
+                return
             for k in range(W):  # exact window, band membership by construction
                 emit({**fixed_vals, l: lo_cur + k}, ok)
             return

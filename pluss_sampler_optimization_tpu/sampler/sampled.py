@@ -107,13 +107,20 @@ def draw_sample_keys(
     return uniq, highs
 
 
-def decode_sample_keys(keys: np.ndarray, highs) -> np.ndarray:
-    """Mixed-radix keys -> normalized iteration tuples (len(keys), depth)."""
+def decode_sample_keys(keys, highs):
+    """Mixed-radix keys -> normalized iteration tuples (len(keys), depth).
+
+    Works on numpy arrays (host) and traced jnp arrays alike: the
+    kernels ship one int64 key per sample and decode on device, which
+    keeps the host->device transfer at 8 bytes/sample (it crosses a
+    network tunnel when the TPU is remote) and moves the divmod chain
+    onto the device."""
+    xp = jnp if isinstance(keys, jnp.ndarray) else np
     cols = []
     for h in reversed(highs):
-        keys, col = np.divmod(keys, h)
+        keys, col = xp.divmod(keys, h)
         cols.append(col)
-    return np.stack(cols[::-1], axis=1).astype(np.int64)
+    return xp.stack(cols[::-1], axis=1).astype(xp.int64)
 
 
 def draw_samples(
@@ -159,27 +166,25 @@ def classify_samples(nt: NestTrace, ref_idx: int, samples):
     return packed, ri, is_share, found
 
 
-def pad_samples(
-    samples: np.ndarray, n_dev: int, min_per_dev: int = 16,
+def pad_keys(
+    keys: np.ndarray, n_dev: int, min_per_dev: int = 16,
     total: int | None = None,
 ):
-    """Pad with weight-0 repeats of row 0 so each of n_dev equal shards
-    gets at least min_per_dev rows (or exactly total/n_dev when `total`
-    is given, to keep one compiled shape across batch chunks)."""
-    s = len(samples)
+    """Pad sample keys with repeats of key 0 so each of n_dev equal
+    shards gets at least min_per_dev entries (or exactly total/n_dev
+    when `total` is given, to keep one compiled shape across batch
+    chunks). Returns (padded keys, valid count); the kernels
+    reconstruct the padding weight mask from the count on device."""
+    s = len(keys)
     if s == 0:
-        raise ValueError("pad_samples needs at least one sample row")
+        raise ValueError("pad_keys needs at least one sample key")
     if total is None:
         per_dev = max(min_per_dev, -(-s // n_dev))
         total = per_dev * n_dev
     assert total % n_dev == 0 and total >= s
-    w = np.zeros(total, dtype=np.int64)
-    w[:s] = 1
-    if total > s:
-        samples = np.concatenate(
-            [samples, np.repeat(samples[:1], total - s, axis=0)]
-        )
-    return samples, w
+    out = np.full(total, keys[0], dtype=np.int64)
+    out[:s] = keys
+    return out, s
 
 
 def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
@@ -196,19 +201,21 @@ def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
 
 
 def _build_ref_kernel(nt: NestTrace, ref_idx: int):
-    """jitted (samples, weights) -> packed unique pairs + cold count.
+    """jitted (sample keys, valid count) -> packed unique pairs + cold.
 
-    Samples arrive as int32 (coordinates always fit; halves the
-    host->device transfer, which crosses a network tunnel when the TPU
-    is remote) and are widened on device.
+    Samples arrive as mixed-radix int64 keys, one per sample — the
+    minimal wire format (the host->device link crosses a network tunnel
+    when the TPU is remote) — and are decoded by the device's divmod
+    chain; the padding weight mask is likewise reconstructed on device
+    from the valid count.
     """
     check_packed_ratios(nt)
 
-    @functools.partial(jax.jit, static_argnames=("capacity",))
-    def kernel(samples, weights, capacity: int):
-        samples = samples.astype(jnp.int64)
+    @functools.partial(jax.jit, static_argnames=("highs", "capacity"))
+    def kernel(sample_keys, n_valid, highs: tuple, capacity: int):
+        samples = decode_sample_keys(jnp.asarray(sample_keys), highs)
         packed, _, _, found = classify_samples(nt, ref_idx, samples)
-        w = weights.astype(bool)
+        w = jnp.arange(sample_keys.shape[0], dtype=jnp.int64) < n_valid
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
         cold = jnp.sum((~found & w).astype(jnp.int64))
         return keys, counts, n_unique, cold
@@ -298,7 +305,7 @@ def warmup(
 ) -> None:
     """Compile every per-ref kernel at the exact shapes a subsequent
     sampled_outputs run will use, on dummy batches sized through the
-    same pad_samples logic — orders of magnitude cheaper than a full
+    same pad_keys logic — orders of magnitude cheaper than a full
     warm-up run when the sample count is large (the benchmark's N=4096
     warm-up dropped from ~15 min of re-drawing 275M samples to
     seconds). Only the base `capacity` is compiled: the rare
@@ -308,13 +315,13 @@ def warmup(
     trace, kernels = _program_kernels(program, machine)
     for k, ri, kernel in kernels:
         nt = trace.nests[k]
-        lv = int(nt.tables.ref_levels[ri])
-        _, s = _sample_highs(nt, ri, cfg)
-        rows = np.zeros((min(s, batch), lv + 1), dtype=np.int64)
-        chunk, w = pad_samples(rows, 1, total=batch if s > batch else None)
+        highs, s = _sample_highs(nt, ri, cfg)
+        keys = np.zeros(min(s, batch), dtype=np.int64)
+        chunk, n_valid = pad_keys(
+            keys, 1, total=batch if s > batch else None
+        )
         jax.block_until_ready(
-            kernel(jnp.asarray(chunk.astype(np.int32)), jnp.asarray(w),
-                   capacity)
+            kernel(jnp.asarray(chunk), n_valid, tuple(highs), capacity)
         )
 
 
@@ -343,7 +350,7 @@ def sampled_outputs(
 
         def drain(entry):
             nonlocal cold, cap
-            out, chunk, w, dispatch_cap = entry
+            out, chunk, n_valid, dispatch_cap = entry
             keys, counts, n_unique, c = jax.device_get(out)
             while int(n_unique) > dispatch_cap:
                 # rare: more distinct (reuse, class) pairs than slots —
@@ -351,19 +358,21 @@ def sampled_outputs(
                 dispatch_cap = max(dispatch_cap * 4, int(n_unique))
                 cap = max(cap, dispatch_cap)
                 keys, counts, n_unique, c = jax.device_get(
-                    kernel(chunk, w, dispatch_cap)
+                    kernel(chunk, n_valid, tuple(highs), dispatch_cap)
                 )
             cold += float(c)
             decode_pairs(keys, counts, noshare, share)
 
         for s0 in range(0, n_samples, batch):
-            chunk, w = pad_samples(
-                decode_sample_keys(keys_all[s0 : s0 + batch], highs), 1,
+            chunk, n_valid = pad_keys(
+                keys_all[s0 : s0 + batch], 1,
                 total=batch if n_samples > batch else None,
             )
-            chunk = jnp.asarray(chunk.astype(np.int32))
-            w = jnp.asarray(w)
-            pending.append((kernel(chunk, w, cap), chunk, w, cap))
+            chunk = jnp.asarray(chunk)
+            pending.append(
+                (kernel(chunk, n_valid, tuple(highs), cap), chunk,
+                 n_valid, cap)
+            )
             if len(pending) >= 4:
                 drain(pending.pop(0))
         for entry in pending:
