@@ -59,13 +59,104 @@ class NestTrace:
         self.npre = tuple(
             len(self.nest.refs_at(l, "pre")) for l in range(self.nest.depth)
         )
+        self.npost = tuple(
+            len(self.nest.refs_at(l, "post")) for l in range(self.nest.depth)
+        )
+        # Triangular nests (inner bounds affine in the parallel value):
+        # body sizes vary per parallel iteration, so the per-thread
+        # position bases are prefix sums over the thread's dispatch
+        # order instead of m * acc[0]. The table is small (threads x
+        # local parallel iterations) and shared by every engine.
+        self.tri = self.nest.is_triangular
+        if self.tri:
+            P = self.schedule.threads
+            lmax = self.schedule.max_local_count()
+            base = np.zeros((P, lmax + 1), dtype=np.int64)
+            for tid in range(P):
+                lc = self.schedule.local_count(tid)
+                if lc:
+                    m = np.arange(lc, dtype=np.int64)
+                    v0 = self.schedule.local_to_value(tid, m)
+                    base[tid, 1 : lc + 1] = np.cumsum(self.body_at(0, v0))
+                base[tid, lc + 1 :] = base[tid, lc]
+            self.tri_base = base
+            v0_all = lp0.start + np.arange(lp0.trip, dtype=np.int64) * lp0.step
+            self.max_trips = tuple(
+                int(np.max(self.trip_at(l, v0_all)))
+                for l in range(self.nest.depth)
+            )
+            self.max_body0 = int(np.max(self.body_at(0, v0_all)))
+        else:
+            self.max_trips = tuple(lp.trip for lp in self.nest.loops)
+            self.max_body0 = int(self.acc[0])
 
     @property
     def acc(self) -> np.ndarray:
         return self.tables.acc_per_level
 
+    def trip_at(self, level: int, v0):
+        """Level trip count at parallel value v0 (elementwise)."""
+        return self.nest.loops[level].trip_at(v0)
+
+    def body_at(self, level: int, v0):
+        """Accesses of ONE full level-`level` iteration at parallel
+        value v0 (elementwise over arrays; constant when rectangular)."""
+        n = self.npre[level] + self.npost[level]
+        if level + 1 < self.nest.depth:
+            n = n + self.trip_at(level + 1, v0) * self.body_at(level + 1, v0)
+        return n
+
+    def ref_offset_at(self, ref_idx: int, v0):
+        """Body offset of a ref within its level's iteration at v0."""
+        r = self.nest.refs[ref_idx]
+        pre = self.nest.refs_at(r.level, "pre")
+        if r.slot == "pre":
+            return pre.index(r)
+        inner = (
+            self.trip_at(r.level + 1, v0) * self.body_at(r.level + 1, v0)
+            if r.level + 1 < self.nest.depth
+            else 0
+        )
+        return len(pre) + inner + self.nest.refs_at(r.level, "post").index(r)
+
+    def tri_position(self, ref_idx: int, v0, base, n1=0, n2=0):
+        """Thread-local position in a triangular nest.
+
+        `base` = accesses the thread performed before this parallel
+        iteration (tri_base[tid, m] or a traced gather of it), `v0` the
+        parallel value; elementwise over arrays.
+        """
+        lv = int(self.tables.ref_levels[ref_idx])
+        p = base + self.ref_offset_at(ref_idx, v0)
+        if lv >= 1:
+            p = p + self.npre[0] + n1 * self.body_at(1, v0)
+        if lv >= 2:
+            p = p + self.npre[1] + n2 * self.body_at(2, v0)
+        return p
+
+    def level_value_range(self, level: int) -> tuple[int, int]:
+        """[min, max] iteration value a level can take across the nest
+        (exact for triangular levels: evaluated over the parallel
+        values that give the level at least one iteration)."""
+        lp = self.nest.loops[level]
+        if level == 0 or not lp.is_triangular:
+            return min(lp.start, lp.last), max(lp.start, lp.last)
+        lp0 = self.nest.loops[0]
+        v0 = lp0.start + np.arange(lp0.trip, dtype=np.int64) * lp0.step
+        trips = lp.trip_at(v0)
+        live = trips > 0
+        if not live.any():
+            return lp.start, lp.start
+        first = lp.start_at(v0[live])
+        last = first + (trips[live] - 1) * lp.step
+        return int(min(first.min(), last.min())), int(
+            max(first.max(), last.max())
+        )
+
     def tid_length(self, tid: int) -> int:
         """Total accesses simulated thread `tid` performs in this nest."""
+        if self.tri:
+            return int(self.tri_base[tid, self.schedule.local_count(tid)])
         return self.schedule.local_count(tid) * int(self.acc[0])
 
     def access_position(self, ref_idx: int, m, n1=0, n2=0):
@@ -73,7 +164,14 @@ class NestTrace:
 
         `m` is the thread-local parallel-iteration index; n1/n2 are
         normalized inner-loop indices (ignored beyond the ref's level).
+        Rectangular nests only — triangular positions need the
+        per-thread base table (tri_position).
         """
+        if self.tri:
+            raise NotImplementedError(
+                "access_position is undefined for triangular nests; "
+                "use tri_position with tri_base"
+            )
         t = self.tables
         level = int(t.ref_levels[ref_idx])
         p = m * int(t.acc_per_level[0]) + int(t.ref_offsets[ref_idx])
@@ -127,6 +225,8 @@ class NestTrace:
             return z, z.copy()
         m = np.arange(m_lo, L, dtype=np.int64)
         v0 = sched.local_to_value(tid, m)
+        if self.tri:
+            return self._enumerate_ref_tri(tid, ref_idx, m, v0, sched)
         if level == 0:
             pos = self.access_position(ref_idx, m)
             addr = self.ref_addr(ref_idx, v0)
@@ -153,6 +253,49 @@ class NestTrace:
         )
         addr = np.broadcast_to(addr, pos.shape)
         return pos.ravel().astype(np.int64), addr.ravel().astype(np.int64)
+
+    def _enumerate_ref_tri(self, tid, ref_idx, m, v0, sched):
+        """Triangular-nest enumeration: ragged inner grids via masks.
+
+        Requires the nest's own static schedule (tri_base is built for
+        it); alternative schedules would need their own base tables.
+        """
+        assert sched is self.schedule, (
+            "triangular enumeration supports the nest schedule only"
+        )
+        level = int(self.tables.ref_levels[ref_idx])
+        base = self.tri_base[tid, m]
+        if level == 0:
+            pos = self.tri_position(ref_idx, v0, base)
+            addr = np.broadcast_to(self.ref_addr(ref_idx, v0), pos.shape)
+            return pos.astype(np.int64), addr.astype(np.int64).copy()
+        lp1 = self.nest.loops[1]
+        t1 = lp1.trip_at(v0)
+        n1 = np.arange(int(t1.max(initial=0)), dtype=np.int64)
+        mask = n1[None, :] < t1[:, None]
+        v1 = lp1.start_at(v0)[:, None] + n1[None, :] * lp1.step
+        if level == 1:
+            pos = self.tri_position(
+                ref_idx, v0[:, None], base[:, None], n1[None, :]
+            )
+            addr = np.broadcast_to(
+                self.ref_addr(ref_idx, v0[:, None], v1), pos.shape
+            )
+            return pos[mask].astype(np.int64), addr[mask].astype(np.int64)
+        lp2 = self.nest.loops[2]
+        t2 = lp2.trip_at(v0)
+        n2 = np.arange(int(t2.max(initial=0)), dtype=np.int64)
+        mask = mask[:, :, None] & (n2[None, None, :] < t2[:, None, None])
+        v2 = lp2.start_at(v0)[:, None, None] + n2[None, None, :] * lp2.step
+        pos = self.tri_position(
+            ref_idx, v0[:, None, None], base[:, None, None],
+            n1[None, :, None], n2[None, None, :],
+        )
+        addr = np.broadcast_to(
+            self.ref_addr(ref_idx, v0[:, None, None], v1[:, :, None], v2),
+            pos.shape,
+        )
+        return pos[mask].astype(np.int64), addr[mask].astype(np.int64)
 
 
 class ProgramTrace:

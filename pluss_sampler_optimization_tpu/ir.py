@@ -42,21 +42,57 @@ MAX_DEPTH = 3
 
 @dataclasses.dataclass(frozen=True)
 class Loop:
-    """One loop level: iterates start, start+step, ... (trip values)."""
+    """One loop level: iterates start, start+step, ... (trip values).
+
+    Inner levels may be *triangular*: bounds affine in the PARALLEL
+    loop's value v0 (the class PolyBench's symmetric/triangular kernels
+    need — syrk/trmm's `j <= i`, trisolv's `j < i`, covariance's
+    `j >= i`). At parallel value v0 the level iterates
+        start + start_coeff*v0 + k*step   for k in [0, trip_at(v0)),
+        trip_at(v0) = max(0, trip + trip_coeff*v0).
+    The parallel level itself must be rectangular
+    (trip_coeff == start_coeff == 0); bounds depending on non-parallel
+    outer variables (doubly-triangular nests) are out of scope.
+    """
 
     trip: int
     start: int = 0
     step: int = 1
+    trip_coeff: int = 0
+    start_coeff: int = 0
 
     def __post_init__(self) -> None:
-        if self.trip < 1:
+        if self.trip_coeff == 0 and self.trip < 1:
             raise ValueError("trip must be >= 1")
         if self.step == 0:
             raise ValueError("step must be nonzero")
 
     @property
+    def is_triangular(self) -> bool:
+        return self.trip_coeff != 0 or self.start_coeff != 0
+
+    def trip_at(self, v0):
+        """Trip count at parallel value v0 (elementwise over arrays)."""
+        if not self.is_triangular:
+            return self.trip if not hasattr(v0, "shape") else (
+                v0 * 0 + self.trip
+            )
+        t = self.trip + self.trip_coeff * v0
+        if hasattr(t, "shape"):
+            return t.clip(min=0) if isinstance(t, np.ndarray) else t.clip(0)
+        return max(0, t)
+
+    def start_at(self, v0):
+        """First iteration value at parallel value v0."""
+        return self.start + self.start_coeff * v0
+
+    @property
     def last(self) -> int:
-        """The last iteration value (pluss_utils.h:331)."""
+        """The last iteration value (pluss_utils.h:331); rectangular
+        loops only — triangular levels use the nest-level value range
+        helpers."""
+        if self.is_triangular:
+            raise ValueError("last is undefined for a triangular loop")
         return self.start + (self.trip - 1) * self.step
 
 
@@ -123,6 +159,8 @@ class ParallelNest:
     def __post_init__(self) -> None:
         if not 1 <= len(self.loops) <= MAX_DEPTH:
             raise ValueError(f"supported nest depth is 1..{MAX_DEPTH}")
+        if self.loops[0].is_triangular:
+            raise ValueError("the parallel loop must be rectangular")
         for r in self.refs:
             if r.level >= len(self.loops):
                 raise ValueError(f"ref {r.name} deeper than nest")
@@ -135,6 +173,11 @@ class ParallelNest:
     def depth(self) -> int:
         return len(self.loops)
 
+    @property
+    def is_triangular(self) -> bool:
+        """Any inner level's bounds depend on the parallel value."""
+        return any(lp.is_triangular for lp in self.loops[1:])
+
     def refs_at(self, level: int, slot: str) -> tuple[Ref, ...]:
         return tuple(r for r in self.refs if r.level == level and r.slot == slot)
 
@@ -143,8 +186,13 @@ class ParallelNest:
 
         GEMM: acc[2]=4 (A0,B0,C2,C3), acc[1]=2+128*4=514 (C0,C1 + inner),
         acc[0]=128*514 (= the r10 B0 share threshold body,
-        ...rs-ri-opt-r10.cpp:2482).
+        ...rs-ri-opt-r10.cpp:2482). Rectangular nests only — triangular
+        body sizes depend on the parallel value (NestTrace.body_at).
         """
+        if self.is_triangular:
+            raise ValueError(
+                "accesses_per_level_iter is undefined for triangular nests"
+            )
         acc = [0] * self.depth
         for l in range(self.depth - 1, -1, -1):
             n = len(self.refs_at(l, "pre")) + len(self.refs_at(l, "post"))
@@ -154,7 +202,8 @@ class ParallelNest:
         return tuple(acc)
 
     def ref_body_offset(self, ref: Ref) -> int:
-        """Offset of `ref` within one iteration of its level's body."""
+        """Offset of `ref` within one iteration of its level's body
+        (rectangular nests; triangular use NestTrace.ref_offset_at)."""
         pre = self.refs_at(ref.level, "pre")
         if ref.slot == "pre":
             return pre.index(ref)
@@ -212,7 +261,9 @@ class NestTables:
     trips: np.ndarray  # (MAX_DEPTH,) int64, unused levels = 1
     starts: np.ndarray  # (MAX_DEPTH,) int64
     steps: np.ndarray  # (MAX_DEPTH,) int64
-    acc_per_level: np.ndarray  # (MAX_DEPTH,) int64, accesses per level iter
+    trip_coeffs: np.ndarray  # (MAX_DEPTH,) int64, 0 for rectangular
+    start_coeffs: np.ndarray  # (MAX_DEPTH,) int64, 0 for rectangular
+    acc_per_level: np.ndarray  # (MAX_DEPTH,) int64 (-1 when triangular)
     n_refs: int
     ref_levels: np.ndarray  # (n_refs,) int64
     ref_coeffs: np.ndarray  # (n_refs, MAX_DEPTH) int64
@@ -232,10 +283,20 @@ def nest_tables(
     trips = np.ones(MAX_DEPTH, dtype=np.int64)
     starts = np.zeros(MAX_DEPTH, dtype=np.int64)
     steps = np.ones(MAX_DEPTH, dtype=np.int64)
+    trip_cf = np.zeros(MAX_DEPTH, dtype=np.int64)
+    start_cf = np.zeros(MAX_DEPTH, dtype=np.int64)
     for l, lp in enumerate(nest.loops):
         trips[l], starts[l], steps[l] = lp.trip, lp.start, lp.step
+        trip_cf[l], start_cf[l] = lp.trip_coeff, lp.start_coeff
     acc = np.zeros(MAX_DEPTH, dtype=np.int64)
-    acc[:d] = nest.accesses_per_level_iter()
+    if nest.is_triangular:
+        acc[:] = -1  # body sizes depend on v0: use NestTrace.body_at
+        offsets = np.full(len(nest.refs), -1, dtype=np.int64)
+    else:
+        acc[:d] = nest.accesses_per_level_iter()
+        offsets = np.array(
+            [nest.ref_body_offset(r) for r in nest.refs], dtype=np.int64
+        )
     refs = nest.refs
     coeffs = np.zeros((len(refs), MAX_DEPTH), dtype=np.int64)
     for i, r in enumerate(refs):
@@ -245,13 +306,15 @@ def nest_tables(
         trips=trips,
         starts=starts,
         steps=steps,
+        trip_coeffs=trip_cf,
+        start_coeffs=start_cf,
         acc_per_level=acc,
         n_refs=len(refs),
         ref_levels=np.array([r.level for r in refs], dtype=np.int64),
         ref_coeffs=coeffs,
         ref_consts=np.array([r.const for r in refs], dtype=np.int64),
         ref_arrays=np.array([program.array_id(r.array) for r in refs], dtype=np.int64),
-        ref_offsets=np.array([nest.ref_body_offset(r) for r in refs], dtype=np.int64),
+        ref_offsets=offsets,
         ref_share_thresholds=np.array(
             [r.share_threshold if r.share_threshold is not None else -1 for r in refs],
             dtype=np.int64,

@@ -7,6 +7,7 @@ reference's BASELINE configs name but ship no generated sampler for).
 
 from .atax import atax
 from .bicg import bicg
+from .covariance import covariance
 from .doitgen import doitgen
 from .fdtd2d import fdtd2d
 from .gemm import gemm
@@ -18,6 +19,9 @@ from .mm2 import mm2
 from .mm3 import mm3
 from .mvt import mvt
 from .syrk import syrk_rect
+from .syrk_tri import syrk_tri
+from .trisolv import trisolv
+from .trmm import trmm
 
 REGISTRY = {
     "gemm": gemm,
@@ -33,10 +37,14 @@ REGISTRY = {
     "doitgen": doitgen,
     "fdtd-2d": fdtd2d,
     "heat-3d": heat3d,
+    "syrk-tri": syrk_tri,
+    "trmm": trmm,
+    "trisolv": trisolv,
+    "covariance": covariance,
 }
 
 __all__ = [
     "gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "mvt", "bicg",
     "gesummv", "atax", "gemver", "doitgen", "fdtd2d", "heat3d",
-    "REGISTRY",
+    "syrk_tri", "trmm", "trisolv", "covariance", "REGISTRY",
 ]

@@ -1,10 +1,7 @@
 """PolyBench syrk (rectangular 3.2 variant) as a PLUSS program.
 
 BASELINE.json config 4 names syrk. PolyBench/C 3.2's syrk is the
-rectangular form (4.2's is triangular; triangular trip counts need
-outer-variable-dependent bounds, which the array engines do not model
-yet — the serial oracle would accept them, so this is an engine
-restriction, tracked for a later round):
+rectangular form; the 4.2 triangular form is models/syrk_tri.py:
 
     for (i < N) for (j < N) C[i][j] *= beta;              // C0,C1
     for (i < N) for (j < N)

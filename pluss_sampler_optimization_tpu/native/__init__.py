@@ -97,6 +97,8 @@ def run_serial_native(
     trips = _i64(np.stack([t.trips for t in tables]))
     starts = _i64(np.stack([t.starts for t in tables]))
     steps = _i64(np.stack([t.steps for t in tables]))
+    trip_cf = _i64(np.stack([t.trip_coeffs for t in tables]))
+    start_cf = _i64(np.stack([t.start_coeffs for t in tables]))
     ref_off = _i64(np.cumsum([0] + [t.n_refs for t in tables]))
     levels = _i64(np.concatenate([t.ref_levels for t in tables]))
     coeffs = _i64(np.concatenate([t.ref_coeffs for t in tables]))
@@ -125,6 +127,7 @@ def run_serial_native(
         ctypes.c_int64(machine.cls),
         ctypes.c_int64(n_nests),
         _ptr(depths), _ptr(trips), _ptr(starts), _ptr(steps),
+        _ptr(trip_cf), _ptr(start_cf),
         _ptr(ref_off), _ptr(levels), _ptr(coeffs), _ptr(consts),
         _ptr(arrays), _ptr(slots), _ptr(thrs), _ptr(ratios),
         ctypes.c_int64(len(program.arrays)),

@@ -50,6 +50,9 @@ struct Ref {
 struct Nest {
   int64_t depth;
   std::array<int64_t, kMaxDepth> trips, starts, steps;
+  // triangular bounds: affine-in-parallel-value coefficients, 0 when
+  // rectangular (ir.py::Loop.trip_at / start_at)
+  std::array<int64_t, kMaxDepth> trip_coeffs, start_coeffs;
   // refs grouped per (level, slot), program order preserved
   std::array<std::vector<Ref>, kMaxDepth> pre, post;
 };
@@ -105,8 +108,12 @@ void body(State& s, const Nest& nest, int64_t tid, int64_t level,
           int64_t* ivs) {
   for (const Ref& r : nest.pre[level]) access(s, tid, r, ivs);
   if (level + 1 < nest.depth) {
-    const int64_t trip = nest.trips[level + 1];
-    const int64_t start = nest.starts[level + 1];
+    // triangular levels: bounds affine in the parallel value ivs[0]
+    const int64_t trip =
+        std::max<int64_t>(0, nest.trips[level + 1] +
+                                 nest.trip_coeffs[level + 1] * ivs[0]);
+    const int64_t start =
+        nest.starts[level + 1] + nest.start_coeffs[level + 1] * ivs[0];
     const int64_t step = nest.steps[level + 1];
     for (int64_t n = 0; n < trip; ++n) {
       ivs[level + 1] = start + n * step;
@@ -126,6 +133,7 @@ int64_t pluss_run_serial(
     int64_t thread_num, int64_t chunk_size, int64_t ds, int64_t cls,
     int64_t n_nests, const int64_t* depths, const int64_t* trips,
     const int64_t* starts, const int64_t* steps,
+    const int64_t* trip_coeffs, const int64_t* start_coeffs,
     const int64_t* nest_ref_off, const int64_t* ref_levels,
     const int64_t* ref_coeffs, const int64_t* ref_consts,
     const int64_t* ref_arrays, const int64_t* ref_slots,
@@ -155,6 +163,8 @@ int64_t pluss_run_serial(
       nest.trips[l] = trips[k * kMaxDepth + l];
       nest.starts[l] = starts[k * kMaxDepth + l];
       nest.steps[l] = steps[k * kMaxDepth + l];
+      nest.trip_coeffs[l] = trip_coeffs[k * kMaxDepth + l];
+      nest.start_coeffs[l] = start_coeffs[k * kMaxDepth + l];
     }
     for (int64_t i = nest_ref_off[k]; i < nest_ref_off[k + 1]; ++i) {
       Ref r;

@@ -92,8 +92,9 @@ def run_serial(
                 access(tid, ref, ivs)
             if level + 1 < depth:
                 lp = nest.loops[level + 1]
-                for n in range(lp.trip):
-                    ivs.append(lp.start + n * lp.step)
+                # triangular levels: bounds affine in the parallel value
+                for n in range(lp.trip_at(ivs[0])):
+                    ivs.append(lp.start_at(ivs[0]) + n * lp.step)
                     body(tid, level + 1, ivs)
                     ivs.pop()
             for ref in post[level]:

@@ -106,6 +106,12 @@ def _sharded_program_kernels(
     trace = ProgramTrace(program, machine)
     kernels = []
     for k, nt in enumerate(trace.nests):
+        if nt.tri:
+            raise NotImplementedError(
+                f"{program.name}: the sampled engine has no closed-form "
+                "next-use for triangular nests yet; use the dense or "
+                "stream engine"
+            )
         for ri in range(nt.tables.n_refs):
             kernels.append(
                 [k, ri,

@@ -63,8 +63,7 @@ def nest_geometry(nt: NestTrace):
         lo = int(t.ref_consts[ri])
         for l in range(level + 1):
             c = int(t.ref_coeffs[ri][l])
-            lo_v = nt.nest.loops[l].start
-            hi_v = nt.nest.loops[l].last
+            lo_v, hi_v = nt.level_value_range(l)
             hi += max(c * lo_v, c * hi_v)
             lo += min(c * lo_v, c * hi_v)
         if lo < 0:
@@ -84,7 +83,7 @@ def nest_geometry(nt: NestTrace):
 
 def packed_ref_keys(
     nt: NestTrace, ri: int, v0, mrel, valid_m, pos_bits: int,
-    max_addr: int, n_groups: int,
+    max_addr: int, n_groups: int, base=None,
 ):
     """Packed (group, position, ref) sort keys of one ref's accesses
     over an m-grid.
@@ -93,18 +92,68 @@ def packed_ref_keys(
     parallel indices (equal to the thread-local m for the one-shot
     engine, chunk-relative for the streaming engine), `valid_m` the
     raggedness mask. Invalid entries land in group n_groups-1.
+
+    Triangular nests pass `base` — the position-relative access base of
+    each m (a tri_base gather) replacing mrel * acc[0]; inner grids pad
+    to the nest-wide max trip and mask the dead tail, and positions go
+    through tri_position.
     """
     t = nt.tables
     machine = nt.machine
     level = int(t.ref_levels[ri])
     c = t.ref_coeffs[ri]
-    off = int(t.ref_offsets[ri])
-    a0 = int(t.acc_per_level[0])
-    if level == 0:
+    if nt.tri:
+        assert base is not None, "triangular packed keys need a base"
+        if level == 0:
+            pos = nt.tri_position(ri, v0, base)
+            flat = v0 * int(c[0]) + int(t.ref_consts[ri])
+            valid = valid_m
+        else:
+            lp1 = nt.nest.loops[1]
+            t1v = nt.trip_at(1, v0)
+            n1 = jnp.arange(nt.max_trips[1], dtype=jnp.int64)
+            v1 = lp1.start_at(v0)[:, None] + n1[None, :] * lp1.step
+            valid = valid_m[:, None] & (n1[None, :] < t1v[:, None])
+            if level == 1:
+                pos = nt.tri_position(ri, v0[:, None], base[:, None],
+                                      n1[None, :])
+                flat = (
+                    v0[:, None] * int(c[0])
+                    + v1 * int(c[1])
+                    + int(t.ref_consts[ri])
+                )
+            else:
+                lp2 = nt.nest.loops[2]
+                t2v = nt.trip_at(2, v0)
+                n2 = jnp.arange(nt.max_trips[2], dtype=jnp.int64)
+                v2 = (lp2.start_at(v0)[:, None, None]
+                      + n2[None, None, :] * lp2.step)
+                valid = valid[:, :, None] & (
+                    n2[None, None, :] < t2v[:, None, None]
+                )
+                pos = nt.tri_position(
+                    ri, v0[:, None, None], base[:, None, None],
+                    n1[None, :, None], n2[None, None, :],
+                )
+                flat = (
+                    v0[:, None, None] * int(c[0])
+                    + v1[:, :, None] * int(c[1])
+                    + v2 * int(c[2])
+                    + int(t.ref_consts[ri])
+                )
+        pos = jnp.broadcast_to(pos, valid.shape)
+        flat = jnp.broadcast_to(flat, valid.shape)
+        # masked entries carry pos 0 so the packed key stays in range
+        pos = jnp.where(valid, pos, 0)
+    elif level == 0:
+        a0 = int(t.acc_per_level[0])
+        off = int(t.ref_offsets[ri])
         pos = mrel * a0 + off
         flat = v0 * int(c[0]) + int(t.ref_consts[ri])
         valid = valid_m
     elif level == 1:
+        a0 = int(t.acc_per_level[0])
+        off = int(t.ref_offsets[ri])
         t1 = nt.nest.loops[1]
         n1 = jnp.arange(t1.trip, dtype=jnp.int64)
         v1 = t1.start + n1 * t1.step
@@ -121,6 +170,8 @@ def packed_ref_keys(
         )
         valid = jnp.broadcast_to(valid_m[:, None], pos.shape)
     else:
+        a0 = int(t.acc_per_level[0])
+        off = int(t.ref_offsets[ri])
         t1, t2 = nt.nest.loops[1], nt.nest.loops[2]
         n1 = jnp.arange(t1.trip, dtype=jnp.int64)
         n2 = jnp.arange(t2.trip, dtype=jnp.int64)
@@ -160,13 +211,17 @@ def _nest_device_arrays(nt: NestTrace, max_share_values: int):
         [sched.local_count(tt) for tt in range(sched.threads)], dtype=jnp.int64
     )
     n_arrays, max_addr, n_groups = nest_geometry(nt)
-    pos_bits = _ceil_log2(lmax * int(t.acc_per_level[0]) + 1)
+    pos_bound = max(
+        (nt.tid_length(tt) for tt in range(sched.threads)), default=1
+    )
+    pos_bits = _ceil_log2(pos_bound + 1)
     grp_bits = _ceil_log2(n_groups + 1)
     assert grp_bits + pos_bits + _REF_BITS <= 63, "key packing overflow"
 
     K = machine.chunk_size
     P = sched.threads
     step0, start0 = sched.step, sched.start
+    base_tab = jnp.asarray(nt.tri_base) if nt.tri else None
 
     def per_tid(tid, zero):
         # `zero` is a traced 0: mixing it into the index grids keeps
@@ -176,9 +231,11 @@ def _nest_device_arrays(nt: NestTrace, max_share_values: int):
         m = jnp.arange(lmax, dtype=jnp.int64) + zero
         valid_m = m < local_counts[tid]
         v0 = start0 + (((m // K) * P + tid) * K + (m % K)) * step0
+        base = base_tab[tid, :lmax] if nt.tri else None
         keys = [
             packed_ref_keys(
-                nt, ri, v0, m, valid_m, pos_bits, max_addr, n_groups
+                nt, ri, v0, m, valid_m, pos_bits, max_addr, n_groups,
+                base=base,
             )
             for ri in range(t.n_refs)
         ]

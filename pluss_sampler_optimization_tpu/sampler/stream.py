@@ -53,11 +53,22 @@ def _stream_nest_kernel(nt: NestTrace, chunk_m: int, max_share: int):
     lmax = sched.max_local_count()
     n_arrays, max_addr, n_groups = nest_geometry(nt)
     n_steps = -(-lmax // chunk_m)
-    a0 = int(t.acc_per_level[0])
     # chunk-local positions for key packing (the full-trace position
     # would overflow 63 bits at large N); positions leave the packed
     # domain as plain int64 before reuse arithmetic
-    pos_bits = _ceil_log2(chunk_m * a0 + 1)
+    if nt.tri:
+        # max accesses any chunk_m-window of any thread performs
+        b = nt.tri_base
+        span = max(
+            int((b[:, min(m0 + chunk_m, b.shape[1] - 1)] - b[:, m0]).max())
+            for m0 in range(0, lmax, chunk_m)
+        ) if lmax else 1
+        pos_bits = _ceil_log2(span + 1)
+        base_tab = jnp.asarray(nt.tri_base)
+    else:
+        a0 = int(t.acc_per_level[0])
+        pos_bits = _ceil_log2(chunk_m * a0 + 1)
+        base_tab = None
     grp_bits = _ceil_log2(n_groups + 1)
     assert grp_bits + pos_bits + _REF_BITS <= 63, "key packing overflow"
 
@@ -77,9 +88,14 @@ def _stream_nest_kernel(nt: NestTrace, chunk_m: int, max_share: int):
         valid_m = m < local_counts[tid]
         v0 = start0 + (((m // K) * P + tid) * K + (m % K)) * step0
         mrel = jnp.arange(chunk_m, dtype=jnp.int64)
+        base = (
+            base_tab[tid, jnp.minimum(m, lmax)] - base_tab[tid, m0]
+            if nt.tri else None
+        )
         keys = [
             packed_ref_keys(
-                nt, ri, v0, mrel, valid_m, pos_bits, max_addr, n_groups
+                nt, ri, v0, mrel, valid_m, pos_bits, max_addr, n_groups,
+                base=base,
             )
             for ri in range(t.n_refs)
         ]
@@ -94,7 +110,8 @@ def _stream_nest_kernel(nt: NestTrace, chunk_m: int, max_share: int):
         is_valid = grp_s != (n_groups - 1)
         # position in the thread's nest-local clock (reuse intervals are
         # position differences, so any constant offset cancels)
-        pos_g = pos_rel + m0 * a0
+        chunk_base = base_tab[tid, m0] if nt.tri else m0 * a0
+        pos_g = pos_rel + chunk_base
         same = jnp.concatenate(
             [jnp.array([False]), (grp_s[1:] == grp_s[:-1]) & is_valid[1:]]
         )
@@ -158,8 +175,7 @@ def _compiled_stream(
     trace = ProgramTrace(program, machine)
     kernels = []
     for nt in trace.nests:
-        a0 = int(nt.tables.acc_per_level[0])
-        cm = chunk_m or max(1, _ELEM_BUDGET // max(1, a0))
+        cm = chunk_m or max(1, _ELEM_BUDGET // max(1, nt.max_body0))
         cm = min(cm, max(1, nt.schedule.max_local_count()))
         kernels.append(_stream_nest_kernel(nt, cm, max_share))
     return trace, kernels

@@ -6,6 +6,7 @@ from pluss_sampler_optimization_tpu.config import MachineConfig
 from pluss_sampler_optimization_tpu.models import (
     atax,
     bicg,
+    covariance,
     doitgen,
     fdtd2d,
     gemm,
@@ -17,6 +18,9 @@ from pluss_sampler_optimization_tpu.models import (
     mm3,
     mvt,
     syrk_rect,
+    syrk_tri,
+    trisolv,
+    trmm,
 )
 from pluss_sampler_optimization_tpu.oracle import run_numpy
 from pluss_sampler_optimization_tpu.sampler import run_dense
@@ -38,6 +42,12 @@ PROGRAMS = [
     doitgen(3, 4, 8),
     fdtd2d(10, 9, tsteps=2),
     heat3d(9),
+    syrk_tri(9),
+    syrk_tri(13, 7),
+    trmm(9),
+    trmm(8, 11),
+    trisolv(13),
+    covariance(9, 7),
 ]
 
 

@@ -54,3 +54,24 @@ def test_native_odd_machine():
 def test_native_share_capacity_error():
     with pytest.raises(RuntimeError):
         native.run_serial_native(gemm(24), MACHINE, share_cap=1)
+
+
+def test_native_triangular_models():
+    from pluss_sampler_optimization_tpu.models import (
+        covariance,
+        syrk_tri,
+        trisolv,
+        trmm,
+    )
+    from pluss_sampler_optimization_tpu.oracle import run_serial
+
+    machine = MachineConfig()
+    for prog in (syrk_tri(9), trmm(8, 11), trisolv(13), covariance(9, 7)):
+        a = run_serial(prog, machine)
+        b = native.run_serial_native(prog, machine)
+        assert a.total_accesses == b.total_accesses
+        assert a.per_tid_accesses == b.per_tid_accesses
+        for ha, hb in zip(a.state.noshare, b.state.noshare):
+            assert ha == hb
+        for sa, sb in zip(a.state.share, b.state.share):
+            assert sa == sb

@@ -6,6 +6,7 @@ from pluss_sampler_optimization_tpu.config import MachineConfig
 from pluss_sampler_optimization_tpu.models import (
     atax,
     bicg,
+    covariance,
     doitgen,
     fdtd2d,
     gemm,
@@ -17,6 +18,9 @@ from pluss_sampler_optimization_tpu.models import (
     mm3,
     mvt,
     syrk_rect,
+    syrk_tri,
+    trisolv,
+    trmm,
 )
 from pluss_sampler_optimization_tpu.oracle import run_numpy, run_serial
 
@@ -37,6 +41,12 @@ PROGRAMS = [
     doitgen(3, 4, 8),  # collapsed (r,q) parallel loop
     fdtd2d(10, 9, tsteps=2),  # constant ref, boundary starts
     heat3d(9),  # 3-coefficient refs
+    syrk_tri(9),  # ascending triangular inner level
+    syrk_tri(13, 7),
+    trmm(9),  # descending triangular + post after triangular subloop
+    trmm(8, 11),
+    trisolv(13),  # zero-trip first iterations, diagonal ref
+    covariance(9, 7),  # mixed rectangular + triangular nests
 ]
 
 

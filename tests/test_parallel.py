@@ -118,3 +118,15 @@ def test_sharded_capacity_overflow_recovers():
     for a, b in zip(small, big):
         assert a.name == b.name and a.noshare == b.noshare
         assert a.share == b.share and a.cold == b.cold
+
+
+def test_sampled_sharded_rejects_triangular():
+    import pytest as _pytest
+
+    from pluss_sampler_optimization_tpu.models import trisolv
+    from pluss_sampler_optimization_tpu.parallel import run_sampled_sharded
+
+    with _pytest.raises(NotImplementedError, match="dense or stream"):
+        run_sampled_sharded(
+            trisolv(13), MachineConfig(), SamplerConfig(ratio=0.5)
+        )

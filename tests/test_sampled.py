@@ -177,3 +177,10 @@ def test_sampled_capacity_overflow_recovers():
         assert a.noshare == b.noshare
         assert a.share == b.share
         assert a.cold == b.cold
+
+
+def test_sampled_rejects_triangular():
+    from pluss_sampler_optimization_tpu.models import trisolv
+
+    with pytest.raises(NotImplementedError, match="triangular"):
+        run_sampled(trisolv(13), MachineConfig(), SamplerConfig(ratio=0.5))

@@ -69,3 +69,17 @@ def test_stream_matches_dense_new_models():
     # 3-coefficient stencil + constant ref + collapsed parallel loop
     for prog in (heat3d(7), fdtd2d(6, 7), doitgen(3, 4, 5), atax(9, 11)):
         _results_equal(run_dense(prog, MACHINE), run_stream(prog, MACHINE, 2))
+
+
+def test_stream_matches_dense_triangular():
+    # ragged per-iteration body sizes under the scan carry
+    from pluss_sampler_optimization_tpu.models import (
+        covariance,
+        syrk_tri,
+        trisolv,
+        trmm,
+    )
+
+    for prog, cm in ((syrk_tri(9), 2), (trmm(8, 11), 3), (trisolv(13), 2),
+                     (covariance(9, 7), 2)):
+        _results_equal(run_dense(prog, MACHINE), run_stream(prog, MACHINE, cm))
