@@ -114,6 +114,19 @@ class StaticSchedule:
     def local_to_value(self, tid, m):
         return self.value(self.local_to_normalized(tid, m))
 
+    def count_below(self, tid, n):
+        """How many of thread `tid`'s iterations have normalized index
+        < n — equivalently, the smallest thread-local index m whose
+        global index is >= n. Elementwise over arrays; the caller
+        clamps n to [0, trip]."""
+        kp = self.chunk * self.threads
+        q = n // kp
+        r = n - q * kp - tid * self.chunk
+        r = r.clip(0, self.chunk) if hasattr(r, "clip") else max(
+            0, min(self.chunk, r)
+        )
+        return q * self.chunk + r
+
 
 def interleaved_order_key(nest_trace, ref_idx: int, samples):
     """Interleaved-execution order of same-reference samples, as one
@@ -146,5 +159,7 @@ def interleaved_order_key(nest_trace, ref_idx: int, samples):
     n0 = samples[:, 0]
     key = sched.local_index(n0)  # (cid, pos) collapsed, tid excluded
     for l in range(1, lv + 1):
-        key = key * int(t.trips[l]) + samples[:, l]
+        # max_trips == trips for rectangular nests; triangular indices
+        # range up to the nest-wide max trip
+        key = key * int(nest_trace.max_trips[l]) + samples[:, l]
     return key

@@ -106,11 +106,11 @@ def _sharded_program_kernels(
     trace = ProgramTrace(program, machine)
     kernels = []
     for k, nt in enumerate(trace.nests):
-        if nt.tri:
+        if nt.tri and any(lp.step != 1 for lp in nt.nest.loops):
             raise NotImplementedError(
-                f"{program.name}: the sampled engine has no closed-form "
-                "next-use for triangular nests yet; use the dense or "
-                "stream engine"
+                f"{program.name}: the closed-form next-use supports "
+                "triangular nests with unit steps only; use the dense "
+                "or stream engine"
             )
         for ri in range(nt.tables.n_refs):
             kernels.append(

@@ -212,25 +212,66 @@ def _ref_vars(nt: NestTrace, ref_idx: int):
     return nz, int(t.ref_consts[ref_idx])
 
 
+def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
+    """Enumerate level-value assignments whose flat map lands in the
+    band [lo, lo+W), recursively largest stride first: each head value
+    divides the residual band, the innermost unit-stride variable takes
+    an exact W-wide window (one value-space interval where the level
+    permits, W per-value candidates otherwise), and a trailing band
+    check covers every other terminal. The candidate count is a static
+    O(1) bound per level. Shared by the rectangular and triangular
+    solvers; `emit(fixed_vals, ok)` receives value-space encodings
+    {level: ("fixval", u) | ("interval", va, vb)}.
+    """
+    nz, d = _ref_vars(nt, sink_idx)
+    lo = lo - d
+
+    def value_span(l):
+        return nt.level_value_range(l)
+
+    def recurse(vars_left, lo_cur, ok, fixed_vals):
+        if not vars_left:
+            # remaining contribution is 0: valid iff 0 in [lo_cur, lo_cur+W)
+            emit(fixed_vals, ok & (lo_cur <= 0) & (lo_cur > -W))
+            return
+        if len(vars_left) == 1 and vars_left[0][1] == 1:
+            l, _ = vars_left[0]
+            if l != 0 and nt.nest.loops[l].step == 1:
+                # one contiguous interval replaces W per-value
+                # candidates (band membership by construction); level 0
+                # is excluded because thread ownership chops its range
+                emit({**fixed_vals, l: ("interval", lo_cur, lo_cur + W)},
+                     ok)
+                return
+            for k in range(W):  # exact window
+                emit({**fixed_vals, l: ("fixval", lo_cur + k)}, ok)
+            return
+        (l, c), rest = vars_left[0], vars_left[1:]
+        r_min = sum(cr * value_span(lr)[0] for lr, cr in rest)
+        r_max = sum(cr * value_span(lr)[1] for lr, cr in rest)
+        u_min = _cdiv(lo_cur - r_max, c)
+        u_max = (lo_cur + W - 1 - r_min) // c
+        n_u = (W - 1 + (r_max - r_min)) // c + 2  # static bound
+        for iu in range(n_u):
+            u = u_min + iu
+            recurse(rest, lo_cur - c * u, ok & (u <= u_max),
+                    {**fixed_vals, l: ("fixval", u)})
+
+    recurse(nz, lo, true_, {})
+
+
 def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
     """Min position > p0 where `sink_idx` touches `line` on thread tid.
 
-    Vectorized over samples (tid, p0, line are arrays). The flat map
-    sum_i c_i*x_i + d must land in the line's band [line*W, line*W + W);
-    candidates for the x_i are enumerated recursively, largest stride
-    first: each head value divides the residual band, the innermost
-    unit-stride variable takes an exact W-wide window, and a trailing
-    band check covers every other terminal. The candidate count is a
-    static O(1) bound per level, so the whole solve stays a fixed
-    vector program. Reduces with min_position_after.
+    Vectorized over samples (tid, p0, line are arrays). Band candidates
+    come from _band_candidates; each is reduced with
+    min_position_after over a (fixed/interval/free)^levels box.
     """
     t = nt.tables
     machine = nt.machine
     sched = nt.schedule
     lv = int(t.ref_levels[sink_idx])
     W = machine.lines_per_element_block
-    nz, d = _ref_vars(nt, sink_idx)
-    lo = line * W - d  # target flat-offset band [lo, lo+W)
 
     # per-sample local-count bound for free level 0
     local_counts = jnp.array(
@@ -253,26 +294,24 @@ def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
         return _LevelSpec.fix(n, ok)
 
     def assemble(fixed_vals, ok):
-        """fixed_vals: {level: value or ('interval', n_lo, n_hi)};
-        `ok` ANDs into every fixed/interval spec."""
+        """fixed_vals: value-space encodings; `ok` ANDs into each."""
         specs = []
         for l in range(lv + 1):
             if l in fixed_vals:
-                fv = fixed_vals[l]
-                if isinstance(fv, tuple) and fv[0] == "interval":
-                    _, n_lo, n_hi = fv
+                kind = fixed_vals[l][0]
+                if kind == "interval":
+                    lp = nt.nest.loops[l]
+                    _, va, vb = fixed_vals[l]
+                    n_lo = jnp.maximum(va - lp.start, 0)
+                    n_hi = jnp.minimum(vb - lp.start, lp.trip)
                     specs.append(_LevelSpec.interval(
                         n_lo, jnp.where(ok, n_hi, n_lo)
                     ))
                 else:
-                    specs.append(spec_from_value(l, fv, ok))
+                    specs.append(spec_from_value(l, fixed_vals[l][1], ok))
             else:
                 specs.append(_LevelSpec.free(level_bound(l)))
         return specs
-
-    def value_span(l):
-        lp = nt.nest.loops[l]
-        return min(lp.start, lp.last), max(lp.start, lp.last)
 
     best = jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
     true_ = jnp.ones(jnp.shape(p0), dtype=bool)
@@ -284,37 +323,167 @@ def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
             p = jnp.where(ok, p, INF)
         best = jnp.minimum(best, p)
 
-    def recurse(vars_left, lo_cur, ok, fixed_vals):
-        if not vars_left:
-            # remaining contribution is 0: valid iff 0 in [lo_cur, lo_cur+W)
-            emit(fixed_vals, ok & (lo_cur <= 0) & (lo_cur > -W))
-            return
-        if len(vars_left) == 1 and vars_left[0][1] == 1:
-            l, _ = vars_left[0]
-            lp = nt.nest.loops[l]
-            if l != 0 and lp.step == 1:
-                # The W-wide value window [lo_cur, lo_cur+W) maps to one
-                # contiguous normalized-index interval: a single spec
-                # replaces W per-value candidates (band membership and
-                # trip clipping by construction). Level 0 is excluded
-                # because ownership chops its index range per thread.
-                n_lo = jnp.maximum(lo_cur - lp.start, 0)
-                n_hi = jnp.minimum(lo_cur - lp.start + W, lp.trip)
-                emit({**fixed_vals, l: ("interval", n_lo, n_hi)}, ok)
-                return
-            for k in range(W):  # exact window, band membership by construction
-                emit({**fixed_vals, l: lo_cur + k}, ok)
-            return
-        (l, c), rest = vars_left[0], vars_left[1:]
-        r_min = sum(cr * value_span(lr)[0] for lr, cr in rest)
-        r_max = sum(cr * value_span(lr)[1] for lr, cr in rest)
-        u_min = _cdiv(lo_cur - r_max, c)
-        u_max = (lo_cur + W - 1 - r_min) // c
-        n_u = (W - 1 + (r_max - r_min)) // c + 2  # static bound
-        for iu in range(n_u):
-            u = u_min + iu
-            recurse(rest, lo_cur - c * u, ok & (u <= u_max),
-                    {**fixed_vals, l: u})
+    _band_candidates(nt, sink_idx, line * W, W, true_, emit)
+    return best
 
-    recurse(nz, lo, true_, {})
+
+def next_use_candidates_tri(nt: NestTrace, sink_idx: int, tid, p0, line, m0):
+    """Triangular-nest twin of next_use_candidates.
+
+    Same band enumeration (the flat map must land in the line's W-wide
+    band), but positions come from the per-thread prefix-sum base table
+    and every inner-level domain is evaluated at a concrete parallel
+    value v0, because bounds (and so body sizes and offsets) are affine
+    in v0. Three position strategies survive unchanged in shape:
+
+    - same parallel iteration (v0 known per sample): bump the level-1
+      index past p0's, or keep it and bump the level-2 index — exactly
+      min_position_after's B/C arms with v0-dependent body sizes;
+    - a later parallel iteration: every candidate's inner domain is
+      nonempty over an affine *interval* of v0 (each bound contributes
+      one halfspace), so the minimal valid m' > m0 is a closed-form
+      schedule query (count_below) and positions at m' are gathers of
+      the base table.
+
+    Requires every loop step == 1 (all triangular PolyBench kernels;
+    enforced by the caller's gate). `m0` is each sample's thread-local
+    parallel index. Vectorized over samples; returns INF where no later
+    touch exists.
+    """
+    t = nt.tables
+    machine = nt.machine
+    sched = nt.schedule
+    nest = nt.nest
+    lv = int(t.ref_levels[sink_idx])
+    W = machine.lines_per_element_block
+
+    lmax = sched.max_local_count()
+    base_tab = jnp.asarray(nt.tri_base)
+    local_counts = jnp.array(
+        [sched.local_count(tt) for tt in range(sched.threads)],
+        dtype=jnp.int64,
+    )
+    l_count = local_counts[tid]
+    start0, trip0 = nest.loops[0].start, nest.loops[0].trip
+    np0 = nt.npre[0]
+    np1 = nt.npre[1] if nest.depth > 1 else 0
+    a2 = (
+        nt.npre[2] + nt.npost[2] if nest.depth > 2 else 1
+    )  # deepest-level body = its refs
+
+    def base_of(m):
+        return base_tab[tid, jnp.clip(m, 0, lmax)]
+
+    v0_0 = sched.local_to_value(tid, m0)
+    base_0 = base_of(m0)
+
+    def dom_bounds(l, dom, v0m):
+        """Half-open index interval [lo, hi) of domain `dom` at v0m."""
+        lp = nest.loops[l]
+        tripv = lp.trip_at(v0m)
+        if dom is None:  # free
+            return jnp.zeros_like(tripv), tripv
+        kind = dom[0]
+        if kind == "fixval":
+            n = dom[1] - lp.start_at(v0m)
+            ok = (n >= 0) & (n < tripv)
+            return n, jnp.where(ok, n + 1, n)
+        va, vb = dom[1], dom[2]  # value-space interval [va, vb)
+        lo_i = jnp.maximum(va - lp.start_at(v0m), 0)
+        hi_i = jnp.minimum(vb - lp.start_at(v0m), tripv)
+        return lo_i, jnp.maximum(hi_i, lo_i)
+
+    def min_inner_pos(doms, v0m, basem, okm):
+        """Min sink position > p0 within parallel iteration (v0m, basem)."""
+        offv = nt.ref_offset_at(sink_idx, v0m)
+        if lv == 0:
+            pos = basem + offv
+            return jnp.where(okm & (pos > p0), pos, INF)
+        b1 = jnp.maximum(nt.body_at(1, v0m), 1)
+        d1lo, d1hi = dom_bounds(1, doms.get(1), v0m)
+        if lv == 1:
+            rel = p0 - basem - np0 - offv
+            n1 = jnp.maximum(d1lo, rel // b1 + 1)
+            pos = basem + np0 + n1 * b1 + offv
+            return jnp.where(okm & (n1 < d1hi), pos, INF)
+        d2lo, d2hi = dom_bounds(2, doms.get(2), v0m)
+        r = p0 - basem - np0
+        j_a = r // b1
+        rr = r - j_a * b1
+        n1a = jnp.maximum(d1lo, j_a + 1)
+        pos_a = basem + np0 + n1a * b1 + np1 + d2lo * a2 + offv
+        ok_a = okm & (n1a < d1hi) & (d2lo < d2hi)
+        n2 = jnp.maximum(d2lo, (rr - np1 - offv) // a2 + 1)
+        pos_b = basem + np0 + j_a * b1 + np1 + n2 * a2 + offv
+        ok_b = okm & (j_a >= d1lo) & (j_a < d1hi) & (n2 < d2hi)
+        return jnp.minimum(
+            jnp.where(ok_a, pos_a, INF), jnp.where(ok_b, pos_b, INF)
+        )
+
+    def later_m_pos(doms, ok):
+        """Min sink position at any parallel iteration m' > m0.
+
+        Each inner domain is nonempty over an affine v0 halfspace
+        intersection; the minimal valid m' is a count_below query.
+        """
+        vlo = jnp.full(jnp.shape(p0), start0, dtype=jnp.int64)
+        vhi = jnp.full(jnp.shape(p0), start0 + trip0 - 1, dtype=jnp.int64)
+        okc = ok
+
+        def add(a, b):
+            """Accumulate constraint a*v0 + b >= 0 (a static int)."""
+            nonlocal vlo, vhi, okc
+            b = jnp.asarray(b, dtype=jnp.int64)
+            if a > 0:
+                vlo = jnp.maximum(vlo, _cdiv(-b, a))
+            elif a < 0:
+                vhi = jnp.minimum(vhi, b // (-a))
+            else:
+                okc = okc & (b >= 0)
+
+        for l in range(1, lv + 1):
+            lp = nest.loops[l]
+            s, sc = lp.start, lp.start_coeff
+            tr, tc = lp.trip, lp.trip_coeff
+            dom = doms.get(l)
+            if dom is None:
+                add(tc, tr - 1)  # trip(v0) >= 1
+            elif dom[0] == "fixval":
+                u = dom[1]
+                add(-sc, u - s)  # index >= 0
+                add(tc + sc, tr - u + s - 1)  # index < trip(v0)
+            else:
+                va, vb = dom[1], dom[2]
+                add(tc, tr - 1)
+                add(-sc, vb - s - 1)  # interval reaches index > 0
+                add(tc + sc, tr - va + s - 1)  # interval start < trip
+        n_lo = jnp.clip(vlo - start0, 0, trip0)
+        m_a = jnp.maximum(m0 + 1, sched.count_below(tid, n_lo))
+        ok_a = okc & (m_a < l_count)
+        m_ac = jnp.clip(m_a, 0, lmax)
+        v0a = sched.local_to_value(tid, m_ac)
+        ok_a = ok_a & (v0a >= vlo) & (v0a <= vhi)
+        return min_inner_pos(doms, v0a, base_of(m_ac), ok_a)
+
+    best = jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+    true_ = jnp.ones(jnp.shape(p0), dtype=bool)
+
+    def emit(fixed_vals, ok):
+        nonlocal best
+        doms = {l: v for l, v in fixed_vals.items() if l != 0}
+        if 0 in fixed_vals:
+            u0 = fixed_vals[0][1]
+            n0 = u0 - start0
+            okf = ok & (n0 >= 0) & (n0 < trip0)
+            okf = okf & (sched.owner_tid(n0) == tid)
+            mf = jnp.clip(sched.local_index(n0), 0, lmax)
+            pos = min_inner_pos(doms, u0, base_of(mf), okf)
+        else:
+            pos = jnp.minimum(
+                min_inner_pos(doms, v0_0, base_0, ok),
+                later_m_pos(doms, ok),
+            )
+        best = jnp.minimum(best, pos)
+
+    _band_candidates(nt, sink_idx, line * W, W, true_, emit)
     return best

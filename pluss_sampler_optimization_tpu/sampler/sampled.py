@@ -68,12 +68,60 @@ class SampledRefResult:
 
 
 def _sample_highs(nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig):
+    """(bounding-box highs, target sample count) for one tracked ref.
+
+    Triangular nests draw from the rectangular bounding box and reject
+    points outside the per-v0 bounds (draw_sample_keys); the target
+    count generalizes the generated-code expression to
+    ceil(ratio^depth * |valid drawable space|) — the same density over
+    the space that actually exists (rectangular nests keep the exact
+    `ceil(prod(ratio*trip))` form via cfg.num_samples).
+    """
     lv = int(nest_trace.tables.ref_levels[ref_idx])
+    excl = 1 if cfg.exclude_last_iteration else 0
+    if nest_trace.tri and lv >= 1:
+        import math
+
+        lp0 = nest_trace.nest.loops[0]
+        n0_hi = max(1, lp0.trip - excl)
+        highs = [n0_hi] + [
+            max(1, nest_trace.max_trips[l] - excl)
+            for l in range(1, lv + 1)
+        ]
+        v0 = lp0.start + np.arange(n0_hi, dtype=np.int64) * lp0.step
+        cnt = np.ones(len(v0), dtype=np.int64)
+        for l in range(1, lv + 1):
+            cnt *= np.maximum(
+                0, nest_trace.nest.loops[l].trip_at(v0) - excl
+            )
+        space = int(cnt.sum())
+        if space == 0:
+            return highs, 0
+        s = max(1, min(
+            int(math.ceil((cfg.ratio ** (lv + 1)) * space)), space
+        ))
+        return highs, s
     trips = [nest_trace.nest.loops[l].trip for l in range(lv + 1)]
     highs = [
         max(1, t - 1 if cfg.exclude_last_iteration else t) for t in trips
     ]
     return highs, cfg.num_samples(tuple(trips))
+
+
+def _tri_valid_keys(nest_trace: NestTrace, ref_idx: int, keys, highs, excl):
+    """Filter bounding-box keys down to points inside the triangular
+    bounds (n_l < trip_l(v0) - excl for every inner level)."""
+    lv = int(nest_trace.tables.ref_levels[ref_idx])
+    cols = decode_sample_keys(keys, highs)
+    v0 = nest_trace.nest.loops[0].start + cols[:, 0] * (
+        nest_trace.nest.loops[0].step
+    )
+    ok = np.ones(len(keys), dtype=bool)
+    for l in range(1, lv + 1):
+        ok &= cols[:, l] < (
+            nest_trace.nest.loops[l].trip_at(v0) - excl
+        )
+    return keys[ok]
 
 
 def draw_sample_keys(
@@ -87,12 +135,16 @@ def draw_sample_keys(
     """
     highs, s = _sample_highs(nest_trace, ref_idx, cfg)
     rng = np.random.default_rng(seed)
+    tri = nest_trace.tri and int(nest_trace.tables.ref_levels[ref_idx]) >= 1
+    excl = 1 if cfg.exclude_last_iteration else 0
     # Draw-until-s-unique, matching the reference's one-at-a-time
     # redraw loop's sample *set* semantics (r10 :159-185): accumulate
     # uniques, then thin to exactly s with an unbiased random subset
     # (the drawn set is exchangeable, so a uniform subset of it is
     # itself a uniform s-subset of the space; truncating the *sorted*
-    # uniques would bias toward small keys).
+    # uniques would bias toward small keys). Triangular nests draw the
+    # box and reject out-of-bounds points, which preserves uniformity
+    # over the valid space.
     uniq = np.empty(0, dtype=np.int64)
     while len(uniq) < s:
         need = s - len(uniq)
@@ -100,6 +152,10 @@ def draw_sample_keys(
         for h in highs[1:]:
             batch_keys = batch_keys * h + rng.integers(
                 0, h, size=batch_keys.shape
+            )
+        if tri:
+            batch_keys = _tri_valid_keys(
+                nest_trace, ref_idx, batch_keys, highs, excl
             )
         uniq = np.union1d(uniq, batch_keys)  # sorted unique union
     if len(uniq) > s:
@@ -154,8 +210,8 @@ def classify_samples(nt: NestTrace, ref_idx: int, samples):
     truth for both the single-device and the mesh-sharded kernels.
     """
     t = nt.tables
-    tid, p0, line = _sample_geometry(nt, ref_idx, samples)
-    best, best_sink = _best_sink(nt, ref_idx, tid, p0, line)
+    tid, p0, line, m0 = _sample_geometry(nt, ref_idx, samples)
+    best, best_sink = _best_sink(nt, ref_idx, tid, p0, line, m0)
     found = best < INF
     ri = jnp.where(found, best - p0, 0)
     thr = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)[best_sink]
@@ -224,36 +280,49 @@ def _build_ref_kernel(nt: NestTrace, ref_idx: int):
 
 
 def _sample_geometry(nt: NestTrace, ref_idx: int, samples):
-    """Sample tuples -> (tid, p0, line) in the thread-local trace."""
+    """Sample tuples -> (tid, p0, line, m) in the thread-local trace."""
     t = nt.tables
     sched = nt.schedule
     lv = int(t.ref_levels[ref_idx])
     n = [samples[:, l] for l in range(lv + 1)]
     tid = sched.owner_tid(n[0])
     m = sched.local_index(n[0])
-    vals = [
-        nt.nest.loops[l].start + n[l] * nt.nest.loops[l].step
-        for l in range(lv + 1)
+    v0 = sched.value(n[0])
+    vals = [v0] + [
+        nt.nest.loops[l].start_at(v0) + n[l] * nt.nest.loops[l].step
+        for l in range(1, lv + 1)
     ]
-    p0 = nt.access_position(
-        ref_idx, m, n[1] if lv >= 1 else 0, n[2] if lv >= 2 else 0
-    )
+    if nt.tri:
+        base = jnp.asarray(nt.tri_base)[tid, m]
+        p0 = nt.tri_position(
+            ref_idx, v0, base, n[1] if lv >= 1 else 0,
+            n[2] if lv >= 2 else 0,
+        )
+    else:
+        p0 = nt.access_position(
+            ref_idx, m, n[1] if lv >= 1 else 0, n[2] if lv >= 2 else 0
+        )
     flat = jnp.full_like(p0, int(t.ref_consts[ref_idx]))
     for l in range(lv + 1):
         flat = flat + vals[l] * int(t.ref_coeffs[ref_idx][l])
     line = flat * nt.machine.ds // nt.machine.cls
-    return tid, p0, line
+    return tid, p0, line, m
 
 
-def _best_sink(nt: NestTrace, ref_idx: int, tid, p0, line):
+def _best_sink(nt: NestTrace, ref_idx: int, tid, p0, line, m0):
     """Min next-use position over same-array sink refs + argmin sink."""
+    from .nextuse import next_use_candidates_tri
+
     t = nt.tables
     best = jnp.full_like(p0, INF.item())
     best_sink = jnp.zeros_like(p0, dtype=jnp.int32)
     for j in range(t.n_refs):
         if t.ref_arrays[j] != t.ref_arrays[ref_idx]:
             continue
-        pj = next_use_candidates(nt, j, tid, p0, line)
+        if nt.tri:
+            pj = next_use_candidates_tri(nt, j, tid, p0, line, m0)
+        else:
+            pj = next_use_candidates(nt, j, tid, p0, line)
         take = pj < best
         best = jnp.where(take, pj, best)
         best_sink = jnp.where(take, jnp.int32(j), best_sink)
@@ -273,8 +342,8 @@ def per_sample_ri(
     trace = ProgramTrace(program, machine)
     nt = trace.nests[nest_idx]
     samples = jnp.asarray(np.asarray(samples, dtype=np.int64))
-    tid, p0, line = _sample_geometry(nt, ref_idx, samples)
-    best, best_sink = _best_sink(nt, ref_idx, tid, p0, line)
+    tid, p0, line, m0 = _sample_geometry(nt, ref_idx, samples)
+    best, best_sink = _best_sink(nt, ref_idx, tid, p0, line, m0)
     found = best < INF
     return (
         np.asarray(p0),
@@ -291,11 +360,11 @@ def _program_kernels(program: Program, machine: MachineConfig):
     trace = ProgramTrace(program, machine)
     kernels = []
     for k, nt in enumerate(trace.nests):
-        if nt.tri:
+        if nt.tri and any(lp.step != 1 for lp in nt.nest.loops):
             raise NotImplementedError(
-                f"{program.name}: the sampled engine has no closed-form "
-                "next-use for triangular nests yet; use the dense or "
-                "stream engine"
+                f"{program.name}: the closed-form next-use supports "
+                "triangular nests with unit steps only; use the dense "
+                "or stream engine"
             )
         for ri in range(nt.tables.n_refs):
             kernels.append((k, ri, _build_ref_kernel(nt, ri)))
@@ -322,6 +391,8 @@ def warmup(
     for k, ri, kernel in kernels:
         nt = trace.nests[k]
         highs, s = _sample_highs(nt, ri, cfg)
+        if s == 0:  # no drawable points (degenerate triangular ref)
+            continue
         keys = np.zeros(min(s, batch), dtype=np.int64)
         chunk, n_valid = pad_keys(
             keys, 1, total=batch if s > batch else None

@@ -120,13 +120,21 @@ def test_sharded_capacity_overflow_recovers():
         assert a.share == b.share and a.cold == b.cold
 
 
-def test_sampled_sharded_rejects_triangular():
-    import pytest as _pytest
+def test_sampled_sharded_triangular_matches_unsharded():
+    from pluss_sampler_optimization_tpu.models import syrk_tri
+    from pluss_sampler_optimization_tpu.parallel import (
+        build_mesh,
+        run_sampled_sharded,
+    )
+    from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
 
-    from pluss_sampler_optimization_tpu.models import trisolv
-    from pluss_sampler_optimization_tpu.parallel import run_sampled_sharded
-
-    with _pytest.raises(NotImplementedError, match="dense or stream"):
-        run_sampled_sharded(
-            trisolv(13), MachineConfig(), SamplerConfig(ratio=0.5)
-        )
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.4, seed=5)
+    prog = syrk_tri(12)
+    _, unsh = run_sampled(prog, machine, cfg)
+    _, sh = run_sampled_sharded(prog, machine, cfg, build_mesh(4))
+    for a, b in zip(unsh, sh):
+        assert a.name == b.name
+        assert a.noshare == b.noshare
+        assert a.share == b.share
+        assert a.cold == b.cold

@@ -15,6 +15,7 @@ from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
 from pluss_sampler_optimization_tpu.models import (
     atax,
     bicg,
+    covariance,
     doitgen,
     fdtd2d,
     gemm,
@@ -25,6 +26,9 @@ from pluss_sampler_optimization_tpu.models import (
     mm2,
     mvt,
     syrk_rect,
+    syrk_tri,
+    trisolv,
+    trmm,
 )
 from pluss_sampler_optimization_tpu.sampler.sampled import (
     draw_samples,
@@ -70,7 +74,32 @@ PROGRAMS = [
     (doitgen(3, 4, 5), None),  # collapsed parallel loop
     (fdtd2d(6, 7), None),  # constant ref (no loop variable)
     (heat3d(7), None),  # 3-coefficient flat maps
+    (syrk_tri(9), None),  # ascending triangular level
+    (syrk_tri(10, 6), None),
+    (trmm(8), None),  # descending triangular, post after subloop
+    (trmm(7, 9), None),
+    (trisolv(13), None),  # zero-trip iterations
+    (covariance(8, 6), None),  # mixed rect + triangular nests
+    # trip0 > chunk*threads: samples land in second-round chunks, so
+    # later_m_pos composes count_below with base-table gathers across
+    # the round-robin gap
+    (syrk_tri(19, 5), None),
+    (trmm(18, 4), None),
+    (trisolv(21), None),
 ]
+
+
+def _all_points(nt, ri):
+    """Every valid iteration point of one ref (triangular-aware)."""
+    lv = int(nt.tables.ref_levels[ri])
+    lp0 = nt.nest.loops[0]
+    pts = []
+    for n0 in range(lp0.trip):
+        v0 = lp0.start + n0 * lp0.step
+        trips = [int(nt.nest.loops[l].trip_at(v0)) for l in range(1, lv + 1)]
+        for rest in itertools.product(*[range(tr) for tr in trips]):
+            pts.append((n0,) + rest)
+    return np.array(pts, dtype=np.int64).reshape(len(pts), lv + 1)
 
 
 @pytest.mark.parametrize("program,_", PROGRAMS, ids=lambda p: getattr(p, "name", ""))
@@ -80,12 +109,9 @@ def test_exhaustive_next_use(program, _):
     for k, nt in enumerate(trace.nests):
         t = nt.tables
         for ri in range(t.n_refs):
-            lv = int(t.ref_levels[ri])
-            trips = [nt.nest.loops[l].trip for l in range(lv + 1)]
-            samples = np.array(
-                list(itertools.product(*[range(tr) for tr in trips])),
-                dtype=np.int64,
-            )
+            samples = _all_points(nt, ri)
+            if len(samples) == 0:
+                continue
             p0, ri_got, sink, found, tid, line = per_sample_ri(
                 program, machine, k, ri, samples
             )
@@ -179,8 +205,43 @@ def test_sampled_capacity_overflow_recovers():
         assert a.cold == b.cold
 
 
-def test_sampled_rejects_triangular():
-    from pluss_sampler_optimization_tpu.models import trisolv
+def test_sampled_triangular_end_to_end():
+    """Triangular sampled run: mass conservation + every reuse value in
+    the exact engine's support."""
+    import math
 
-    with pytest.raises(NotImplementedError, match="triangular"):
-        run_sampled(trisolv(13), MachineConfig(), SamplerConfig(ratio=0.5))
+    from pluss_sampler_optimization_tpu.oracle import run_numpy
+
+    machine = MachineConfig()
+    program = trmm(14)
+    dense = run_numpy(program, machine)
+    dense_keys = set()
+    for t in range(4):
+        dense_keys.update(dense.state.noshare[t])
+        for h in dense.state.share[t].values():
+            dense_keys.update(h)
+    _, results = run_sampled(program, machine, SamplerConfig(ratio=0.3, seed=2))
+    total = sum(sum(r.noshare.values()) + r.cold for r in results) + sum(
+        sum(h.values()) for r in results for h in r.share.values()
+    )
+    assert total == sum(r.n_samples for r in results) > 0
+    for r in results:
+        for v in r.noshare:
+            assert (1 << int(math.floor(math.log2(v)))) in dense_keys
+        for h in r.share.values():
+            for v in h:
+                assert v in dense_keys
+
+
+def test_sampled_rejects_non_unit_step_triangular():
+    from pluss_sampler_optimization_tpu.ir import Loop, ParallelNest, Program, Ref
+
+    prog = Program(
+        name="tri-step2",
+        nests=(ParallelNest(
+            loops=(Loop(8, step=2), Loop(trip=1, trip_coeff=1)),
+            refs=(Ref("A0", "A", level=1, coeffs=(8, 1)),),
+        ),),
+    )
+    with pytest.raises(NotImplementedError, match="unit steps"):
+        run_sampled(prog, MachineConfig(), SamplerConfig(ratio=0.5))
