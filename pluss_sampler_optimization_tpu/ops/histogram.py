@@ -11,8 +11,10 @@ vectorize; on TPU the same information is:
 - share intervals: raw values are required downstream (the racetrack
   model uses raw interval lengths, pluss_utils.h:1060-1097), but the
   affine loop nests produce only a handful of distinct values, so a
-  fixed-capacity sorted-unique reduction returns exact (value, count)
-  pairs plus an overflow flag the host asserts on;
+  fixed-capacity exact unique reduction returns (value, count) pairs
+  plus an overflow count the host reacts to — scatter-max hash rounds
+  on the common path, a full sorted reduction as the in-graph
+  fallback;
 - cold (-1) counts: per-array scalars.
 
 All outputs are dense, fixed-shape, and psum-able across a device mesh.
@@ -38,8 +40,9 @@ def exp_hist(values, weights, n_bins: int = N_EXP_BINS):
     return jnp.zeros(n_bins, dtype=jnp.int64).at[e].add(weights.astype(jnp.int64))
 
 
-def fixed_k_unique(values, valid, k: int):
-    """Exact sparse histogram with capacity k over masked int64 values.
+def sorted_k_unique(values, valid, k: int):
+    """Exact sparse histogram with capacity k over masked int64 values,
+    via one full sort + segmented reduction.
 
     Returns (keys[k], counts[k], n_unique). Invalid entries are pushed
     to the end via an int64 sentinel; entries beyond capacity are
@@ -66,3 +69,80 @@ def fixed_k_unique(values, valid, k: int):
         .add(is_valid.astype(jnp.int64))[:k]
     )
     return keys, counts, n_unique
+
+
+def _round_hash(values, salt: int, h_slots: int):
+    """SplitMix64-style avalanche of (values ^ salt), masked to a slot.
+
+    Full bit mixing per round (xor-shift + odd multiplies) makes the
+    per-round hashes effectively independent — an affine reseed would
+    preserve pairwise differences and leave some colliding pairs
+    colliding in every round at every table size.
+    """
+    salt &= (1 << 64) - 1
+    if salt >= 1 << 63:  # to signed two's complement
+        salt -= 1 << 64
+    x = values ^ jnp.int64(salt)
+    x = (x ^ ((x >> 30) & 0x3FFFFFFFF)) * jnp.int64(-0x40A7B892E31B1A47)
+    x = (x ^ ((x >> 27) & 0x1FFFFFFFFF)) * jnp.int64(-0x6B2FB644ECCEEE15)
+    x = x ^ ((x >> 31) & 0x1FFFFFFFF)
+    return x & (h_slots - 1)
+
+
+def fixed_k_unique(values, valid, k: int, rounds: int = 3):
+    """Exact sparse histogram with capacity k over masked int64 values.
+
+    Sort-free on the common path: a few rounds of scatter-max
+    hash-table claiming, each O(n) elementwise work instead of the
+    O(n log n) full sort the affine samplers' handful of distinct
+    values never needed. Per round, every element hashes into an
+    H-slot table, the maximum key claims each slot (ties are the same
+    key), winners scatter-add their counts, and losers (distinct keys
+    colliding in one slot) go to the next round with an independently
+    mixed hash. If any element is still unresolved after the last
+    round, a lax.cond falls back to the full sorted reduction — so the
+    result (including the true n_unique) is always exact and callers
+    need no collision awareness; the sort branch costs compile time
+    but executes only on the rare collision pile-up.
+
+    Returns (keys[k], counts[k], n_unique); entries beyond capacity
+    are dropped (detect via n_unique > k on host).
+    """
+    if rounds < 1:  # degenerate: nothing can resolve, sort directly
+        return sorted_k_unique(values, valid, k)
+    h_slots = max(1024, 4 * k)
+    h_slots = 1 << (h_slots - 1).bit_length()
+    neg = jnp.iinfo(jnp.int64).min
+    remaining = valid
+    key_tabs, cnt_tabs = [], []
+    for r in range(rounds):
+        h = _round_hash(values, r * 0x9E3779B97F4A7C15 + r, h_slots)
+        h_c = jnp.where(remaining, h, h_slots)  # masked -> dropped slot
+        tab = (
+            jnp.full(h_slots + 1, neg, dtype=jnp.int64).at[h_c].max(values)
+        )
+        won = remaining & (tab[h] == values)
+        cnt = (
+            jnp.zeros(h_slots + 1, dtype=jnp.int64)
+            .at[jnp.where(won, h, h_slots)]
+            .add(1)
+        )
+        key_tabs.append(tab[:h_slots])
+        cnt_tabs.append(cnt[:h_slots])
+        remaining = remaining & ~won
+    # each distinct key wins in exactly one (round, slot): the stacked
+    # tables hold unique keys; compact the occupied slots to k outputs
+    allk = jnp.concatenate(key_tabs)
+    allc = jnp.concatenate(cnt_tabs)
+    occupied = allc > 0
+    order = jnp.argsort(jnp.where(occupied, allk, jnp.int64(2**62)))
+    keys = jnp.where(
+        jnp.arange(k) < occupied.sum(), allk[order[:k]], jnp.int64(-1)
+    )
+    counts = jnp.where(keys != -1, allc[order[:k]], 0)
+    n_unique = occupied.sum().astype(jnp.int64)
+    return jax.lax.cond(
+        jnp.any(remaining),
+        lambda: sorted_k_unique(values, valid, k),
+        lambda: (keys, counts, n_unique),
+    )
