@@ -105,8 +105,18 @@ def fixed_k_unique(values, valid, k: int, rounds: int = 3):
     need no collision awareness; the sort branch costs compile time
     but executes only on the rare collision pile-up.
 
-    Returns (keys[k], counts[k], n_unique); entries beyond capacity
-    are dropped (detect via n_unique > k on host).
+    Use this on un-vmapped paths only: under jax.vmap the cond
+    predicate is batched, lowering to a select that executes BOTH
+    branches — the sort then runs every call and the hash rounds are
+    pure overhead. The vmapped dense/stream engines call
+    sorted_k_unique directly instead.
+
+    Values must stay below the 2^62 invalid-entry sentinel of the
+    sorted fallback (every packed reuse key does). Returns
+    (keys[k], counts[k], n_unique); empty output slots carry count 0
+    (the key field of an empty slot is -1, but only counts identify
+    emptiness); entries beyond capacity are dropped (detect via
+    n_unique > k on host).
     """
     if rounds < 1:  # degenerate: nothing can resolve, sort directly
         return sorted_k_unique(values, valid, k)
@@ -131,15 +141,17 @@ def fixed_k_unique(values, valid, k: int, rounds: int = 3):
         cnt_tabs.append(cnt[:h_slots])
         remaining = remaining & ~won
     # each distinct key wins in exactly one (round, slot): the stacked
-    # tables hold unique keys; compact the occupied slots to k outputs
+    # tables hold unique keys; compact the occupied slots to k outputs.
+    # Occupancy is the primary sort key (no value sentinel, so any
+    # int64 key — including -1 or >= 2^62 — compacts correctly); empty
+    # output slots are identified by count 0, never by a key marker.
     allk = jnp.concatenate(key_tabs)
     allc = jnp.concatenate(cnt_tabs)
     occupied = allc > 0
-    order = jnp.argsort(jnp.where(occupied, allk, jnp.int64(2**62)))
-    keys = jnp.where(
-        jnp.arange(k) < occupied.sum(), allk[order[:k]], jnp.int64(-1)
-    )
-    counts = jnp.where(keys != -1, allc[order[:k]], 0)
+    order = jnp.lexsort((allk, ~occupied))
+    valid_out = jnp.arange(k) < occupied.sum()
+    keys = jnp.where(valid_out, allk[order[:k]], jnp.int64(-1))
+    counts = jnp.where(valid_out, allc[order[:k]], 0)
     n_unique = occupied.sum().astype(jnp.int64)
     return jax.lax.cond(
         jnp.any(remaining),

@@ -34,7 +34,7 @@ import numpy as np
 from ..config import MachineConfig
 from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
-from ..ops.histogram import N_EXP_BINS, exp_bin, fixed_k_unique
+from ..ops.histogram import N_EXP_BINS, exp_bin, sorted_k_unique
 from ..oracle.serial import OracleResult
 from ..runtime.hist import PRIState
 
@@ -261,7 +261,7 @@ def _nest_device_arrays(nt: NestTrace, max_share_values: int):
         # share: pack (reuse, ratio) so one unique pass keeps both
         ratio = jnp.array(t.ref_share_ratios, dtype=jnp.int64)[ref_s]
         share_key = reuse * 8 + ratio
-        sk, sc, n_unique = fixed_k_unique(share_key, is_share, max_share_values)
+        sk, sc, n_unique = sorted_k_unique(share_key, is_share, max_share_values)
         # cold lines: first element of each valid group, per array
         is_first = is_valid & ~same
         arr_of = jnp.where(is_valid, grp_s // max_addr, n_arrays)

@@ -36,7 +36,7 @@ import numpy as np
 from ..config import MachineConfig
 from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
-from ..ops.histogram import N_EXP_BINS, exp_bin, fixed_k_unique
+from ..ops.histogram import N_EXP_BINS, exp_bin, sorted_k_unique
 from ..oracle.serial import OracleResult
 from ..runtime.hist import PRIState
 from .dense import _REF_BITS, _ceil_log2, nest_geometry, packed_ref_keys
@@ -130,7 +130,7 @@ def _stream_nest_kernel(nt: NestTrace, chunk_m: int, max_share: int):
         e = exp_bin(jnp.maximum(reuse, 1))
         nosh = nosh.at[e].add(is_noshare.astype(jnp.int64))
         share_key = reuse * 8 + ratio_table[ref_s]
-        sk, sc, nu = fixed_k_unique(share_key, is_share, max_share)
+        sk, sc, nu = sorted_k_unique(share_key, is_share, max_share)
         # carry update: last touch per group (positions ascend in-group;
         # invalid entries scatter -1 into the invalid group, a no-op)
         last_pos = last_pos.at[grp_s].max(

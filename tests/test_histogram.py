@@ -55,6 +55,20 @@ def test_hash_unique_overflow_reports_true_count():
     assert int(na) == int(nb) == 1000
 
 
+def test_hash_unique_hostile_keys():
+    """Keys colliding with internal markers (-1 matches the empty-slot
+    key field; large keys near the sorted path's sentinel) must still
+    count exactly — emptiness is signalled by count 0, not by key."""
+    vals = np.array([-1, -1, 5, 7, (1 << 61), (1 << 61)], dtype=np.int64)
+    valid = np.ones(len(vals), dtype=bool)
+    ka, ca, na = sorted_k_unique(vals, valid, 8)
+    kb, cb, nb = fixed_k_unique(vals, valid, 8)
+    want = {-1: 2, 5: 1, 7: 1, 1 << 61: 2}
+    assert _as_dict(ka, ca) == want
+    assert _as_dict(kb, cb) == want
+    assert int(na) == int(nb) == 4
+
+
 def test_exp_hist_mass():
     vals = np.array([1, 2, 3, 8, 9, 1 << 40], dtype=np.int64)
     w = np.ones(len(vals), dtype=np.int64)
