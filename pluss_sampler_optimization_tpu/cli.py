@@ -75,7 +75,10 @@ def _run_engine(engine: str, program, machine, args):
         if engine == "sampled":
             from .sampler.sampled import run_sampled
 
-            state, results = run_sampled(program, machine, cfg, v2=v2)
+            state, results = run_sampled(
+                program, machine, cfg, v2=v2,
+                checkpoint_dir=args.checkpoint_dir,
+            )
         else:
             from .parallel import build_mesh, run_sampled_sharded
 
@@ -123,6 +126,9 @@ def main(argv=None) -> int:
                     help="trace mode reuse-pair threshold (DEBUG >= 512)")
     ap.add_argument("--limit", type=int, default=50,
                     help="trace mode row limit")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="sample mode: persist finished per-ref results "
+                    "here and resume an interrupted run")
     ap.add_argument("--mrc-out", default=None,
                     help="also write the MRC to this file")
     ap.add_argument("--diff-against", default=None, metavar="ENGINE",
@@ -167,6 +173,10 @@ def main(argv=None) -> int:
     machine = MachineConfig(thread_num=args.threads, chunk_size=args.chunk)
     program = _build_model(args.model, args.n, args.tsteps)
     engine = args.engine or ("sampled" if args.mode == "sample" else "dense")
+    if args.checkpoint_dir is not None and engine != "sampled":
+        raise SystemExit(
+            "--checkpoint-dir is supported by the sampled engine only"
+        )
     if args.mode == "sample" and engine not in ("sampled", "sharded"):
         raise SystemExit("sample mode needs --engine sampled|sharded")
     if args.pallas_hist and engine != "sharded":

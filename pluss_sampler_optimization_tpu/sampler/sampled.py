@@ -402,19 +402,93 @@ def warmup(
         )
 
 
+def _checkpoint_tag(program, machine, cfg, idx: int, name: str) -> str:
+    import hashlib
+
+    # hash the full program structure (loops, refs, thresholds), not
+    # just its name: same-named programs can differ structurally (e.g.
+    # gemm's share_threshold_variant)
+    struct = hashlib.sha256(repr(program).encode()).hexdigest()[:16]
+    return (
+        f"{program.name}/{struct}|{machine.thread_num},"
+        f"{machine.chunk_size},{machine.ds},{machine.cls}|{cfg.ratio},"
+        f"{cfg.seed},{cfg.exclude_last_iteration}|{idx}|{name}"
+    )
+
+
+def _checkpoint_load(path: str, tag: str):
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        if d.get("tag") != tag:
+            return None
+        return SampledRefResult(
+            name=d["name"],
+            noshare={int(k): v for k, v in d["noshare"].items()},
+            share={
+                int(r): {int(k): v for k, v in h.items()}
+                for r, h in d["share"].items()
+            },
+            cold=d["cold"],
+            n_samples=d["n_samples"],
+        )
+    except Exception:
+        return None  # unreadable/foreign/odd-shaped file: recompute
+
+
+def _checkpoint_store(path: str, tag: str, r: SampledRefResult) -> None:
+    import json
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({
+            "tag": tag, "name": r.name, "noshare": r.noshare,
+            "share": r.share, "cold": r.cold, "n_samples": r.n_samples,
+        }, f)
+    os.replace(tmp, path)
+
+
 def sampled_outputs(
     program: Program,
     machine: MachineConfig,
     cfg: SamplerConfig,
     batch: int = DEFAULT_BATCH,
     capacity: int = DEFAULT_CAPACITY,
+    checkpoint_dir: str | None = None,
 ):
-    """Run the sampled engine; one SampledRefResult per reference."""
+    """Run the sampled engine; one SampledRefResult per reference.
+
+    `checkpoint_dir` persists each tracked reference's finished result
+    (atomic JSON per ref, keyed by a program/machine/sampler-config
+    tag) and resumes an interrupted run by skipping refs whose
+    checkpoint matches — a long multi-hour N run survives preemption
+    at per-ref granularity. The reference framework has no
+    checkpointing (its only persisted artifact is the final MRC,
+    pluss_utils.h:885-913); this goes beyond parity by design.
+    """
+    import os
+
     trace, kernels = _program_kernels(program, machine)
+    if checkpoint_dir is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
     results = []
     for idx, (k, ri, kernel) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
+        ck_path = ck_tag = None
+        if checkpoint_dir is not None:
+            ck_tag = _checkpoint_tag(program, machine, cfg, idx, name)
+            ck_path = os.path.join(checkpoint_dir, f"ref_{idx:03d}.json")
+            prior = _checkpoint_load(ck_path, ck_tag)
+            if prior is not None:
+                results.append(prior)
+                continue
         keys_all, highs = draw_sample_keys(
             nt, ri, cfg, seed=cfg.seed * 1000003 + idx
         )
@@ -454,12 +528,13 @@ def sampled_outputs(
                 drain(pending.pop(0))
         for entry in pending:
             drain(entry)
-        results.append(
-            SampledRefResult(
-                name=name, noshare=noshare, share=share, cold=cold,
-                n_samples=n_samples,
-            )
+        result = SampledRefResult(
+            name=name, noshare=noshare, share=share, cold=cold,
+            n_samples=n_samples,
         )
+        if ck_path is not None:
+            _checkpoint_store(ck_path, ck_tag, result)
+        results.append(result)
     return results
 
 

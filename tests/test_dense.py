@@ -73,3 +73,19 @@ def test_dense_gemm128_full_pipeline():
     for t in range(4):
         assert got.state.noshare[t] == ref.state.noshare[t]
         assert got.state.share[t] == ref.state.share[t]
+
+
+def test_dense_triangular_odd_machine():
+    """Triangular base tables under non-default thread/chunk geometry."""
+    from pluss_sampler_optimization_tpu.models import syrk_tri, trmm
+
+    for m in (MachineConfig(thread_num=3, chunk_size=5),
+              MachineConfig(thread_num=5, chunk_size=2)):
+        for prog in (syrk_tri(11), trmm(9, 7)):
+            ref = run_numpy(prog, m)
+            got = run_dense(prog, m)
+            assert got.total_accesses == ref.total_accesses
+            assert got.per_tid_accesses == ref.per_tid_accesses
+            for t in range(m.thread_num):
+                assert got.state.noshare[t] == ref.state.noshare[t]
+                assert got.state.share[t] == ref.state.share[t]

@@ -116,3 +116,15 @@ def test_per_nest_lat_flush():
         assert h[1] == 7 * 4 * 2
     for t in (2, 3):
         assert res.state.noshare[t] == {}
+
+
+def test_triangular_odd_machine_serial_numpy():
+    from pluss_sampler_optimization_tpu.models import trisolv, trmm
+
+    for m in (MachineConfig(thread_num=3, chunk_size=5),
+              MachineConfig(thread_num=6, chunk_size=1)):
+        for prog in (trmm(8, 6), trisolv(17)):
+            ser = run_serial(prog, m)
+            vec = run_numpy(prog, m)
+            assert ser.total_accesses == vec.total_accesses
+            assert_states_equal(ser.state, vec.state)

@@ -245,3 +245,74 @@ def test_sampled_rejects_non_unit_step_triangular():
     )
     with pytest.raises(NotImplementedError, match="unit steps"):
         run_sampled(prog, MachineConfig(), SamplerConfig(ratio=0.5))
+
+
+def test_sampled_checkpoint_resume(tmp_path):
+    """A checkpointed run resumes: completed refs load from disk (the
+    engine is not re-invoked for them), results identical to a fresh
+    run; a stale tag forces recompute."""
+    import json
+
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=7)
+    prog = gemm(16)
+    ck = str(tmp_path / "ck")
+    _, fresh = run_sampled(prog, machine, cfg)
+    _, first = run_sampled(prog, machine, cfg, checkpoint_dir=ck)
+    files = sorted((tmp_path / "ck").glob("ref_*.json"))
+    assert len(files) == len(first) == 6
+
+    # resume must not re-draw: poison draw_sample_keys to prove it
+    from pluss_sampler_optimization_tpu.sampler import sampled as S
+
+    orig = S.draw_sample_keys
+    S.draw_sample_keys = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("resume must not redraw completed refs")
+    )
+    try:
+        _, resumed = run_sampled(prog, machine, cfg, checkpoint_dir=ck)
+    finally:
+        S.draw_sample_keys = orig
+    for a, b, c in zip(fresh, first, resumed):
+        assert a.name == b.name == c.name
+        assert a.noshare == b.noshare == c.noshare
+        assert a.share == b.share == c.share
+        assert a.cold == b.cold == c.cold
+        assert a.n_samples == b.n_samples == c.n_samples
+
+    # a different sampler config invalidates the tag -> recompute works
+    d = json.loads(files[0].read_text())
+    assert "tag" in d
+    _, other = run_sampled(
+        prog, machine, SamplerConfig(ratio=0.5, seed=7), checkpoint_dir=ck
+    )
+    assert sum(r.n_samples for r in other) > sum(r.n_samples for r in fresh)
+
+
+def test_checkpoint_tag_covers_program_structure(tmp_path):
+    """Same-named programs with different structure must not share
+    checkpoints (gemm's r10 threshold variant reuses the name)."""
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.4, seed=1)
+    ck = str(tmp_path / "ck")
+    prog_ri = gemm(16)
+    prog_r10 = gemm(16, share_threshold_variant="r10")
+    assert prog_ri.name == prog_r10.name
+    _, a = run_sampled(prog_ri, machine, cfg, checkpoint_dir=ck)
+    _, b = run_sampled(prog_r10, machine, cfg, checkpoint_dir=ck)
+    _, b_fresh = run_sampled(prog_r10, machine, cfg)
+    for x, y in zip(b, b_fresh):
+        assert x.noshare == y.noshare and x.share == y.share
+
+
+def test_checkpoint_foreign_file_recomputes(tmp_path):
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.4, seed=1)
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "ref_000.json").write_text("[]")  # valid JSON, wrong shape
+    (ck / "ref_001.json").write_text("{not json")
+    _, got = run_sampled(gemm(16), machine, cfg, checkpoint_dir=str(ck))
+    _, want = run_sampled(gemm(16), machine, cfg)
+    for x, y in zip(got, want):
+        assert x.noshare == y.noshare and x.share == y.share
