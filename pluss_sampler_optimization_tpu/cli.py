@@ -103,10 +103,10 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default="gemm",
                     help="gemm | 2mm | 3mm | syrk | jacobi-2d | mvt | bicg "
                     "| gesummv | atax | gemver | doitgen | fdtd-2d | heat-3d"
-                    " | syrk-tri | trmm | trisolv | covariance")
+                    " | syrk-tri | trmm | trisolv | covariance | adi")
     ap.add_argument("--n", type=int, default=128)
     ap.add_argument("--tsteps", type=int, default=1,
-                    help="time steps (jacobi-2d, fdtd-2d, heat-3d)")
+                    help="time steps (jacobi-2d, fdtd-2d, heat-3d, adi)")
     ap.add_argument(
         "--engine",
         default=None,

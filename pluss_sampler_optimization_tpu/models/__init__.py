@@ -5,6 +5,7 @@ generated samplers (or the analogous PolyBench kernel for benchmarks the
 reference's BASELINE configs name but ship no generated sampler for).
 """
 
+from .adi import adi
 from .atax import atax
 from .bicg import bicg
 from .covariance import covariance
@@ -41,10 +42,11 @@ REGISTRY = {
     "trmm": trmm,
     "trisolv": trisolv,
     "covariance": covariance,
+    "adi": adi,
 }
 
 __all__ = [
     "gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "mvt", "bicg",
     "gesummv", "atax", "gemver", "doitgen", "fdtd2d", "heat3d",
-    "syrk_tri", "trmm", "trisolv", "covariance", "REGISTRY",
+    "syrk_tri", "trmm", "trisolv", "covariance", "adi", "REGISTRY",
 ]

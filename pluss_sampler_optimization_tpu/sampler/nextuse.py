@@ -260,13 +260,20 @@ def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
     recurse(nz, lo, true_, {})
 
 
-def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
-    """Min position > p0 where `sink_idx` touches `line` on thread tid.
+def next_use_candidates_group(
+    nt: NestTrace, sinks: tuple, tid, p0, line
+):
+    """Min positions > p0 where each sink in `sinks` touches `line` on
+    thread tid, for sinks sharing one flat map (level, coeffs, const) —
+    only their body offsets differ, so the band candidates and level
+    specs are built once and each sink pays only its own
+    min_position_after reduction. Returns {sink_idx: positions}.
 
     Vectorized over samples (tid, p0, line are arrays). Band candidates
     come from _band_candidates; each is reduced with
     min_position_after over a (fixed/interval/free)^levels box.
     """
+    sink_idx = sinks[0]
     t = nt.tables
     machine = nt.machine
     sched = nt.schedule
@@ -313,22 +320,31 @@ def next_use_candidates(nt: NestTrace, sink_idx: int, tid, p0, line):
                 specs.append(_LevelSpec.free(level_bound(l)))
         return specs
 
-    best = jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+    bests = {
+        j: jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+        for j in sinks
+    }
     true_ = jnp.ones(jnp.shape(p0), dtype=bool)
 
     def emit(fixed_vals, ok):
-        nonlocal best
-        p = min_position_after(nt, sink_idx, p0, assemble(fixed_vals, ok))
-        if not fixed_vals:  # constant ref: no spec carries the validity
-            p = jnp.where(ok, p, INF)
-        best = jnp.minimum(best, p)
+        specs = assemble(fixed_vals, ok)
+        for j in sinks:
+            p = min_position_after(nt, j, p0, specs)
+            if not fixed_vals:  # constant ref: no spec carries validity
+                p = jnp.where(ok, p, INF)
+            bests[j] = jnp.minimum(bests[j], p)
 
     _band_candidates(nt, sink_idx, line * W, W, true_, emit)
-    return best
+    return bests
 
 
-def next_use_candidates_tri(nt: NestTrace, sink_idx: int, tid, p0, line, m0):
-    """Triangular-nest twin of next_use_candidates.
+def next_use_candidates_tri_group(
+    nt: NestTrace, sinks: tuple, tid, p0, line, m0
+):
+    """Triangular-nest twin of next_use_candidates_group (sinks share
+    one flat map; candidates, domain bounds and the later-iteration
+    schedule query are built once, each sink pays only its own
+    position reductions). Returns {sink_idx: positions}.
 
     Same band enumeration (the flat map must land in the line's W-wide
     band), but positions come from the per-thread prefix-sum base table
@@ -350,6 +366,7 @@ def next_use_candidates_tri(nt: NestTrace, sink_idx: int, tid, p0, line, m0):
     parallel index. Vectorized over samples; returns INF where no later
     touch exists.
     """
+    sink_idx = sinks[0]
     t = nt.tables
     machine = nt.machine
     sched = nt.schedule
@@ -393,9 +410,9 @@ def next_use_candidates_tri(nt: NestTrace, sink_idx: int, tid, p0, line, m0):
         hi_i = jnp.minimum(vb - lp.start_at(v0m), tripv)
         return lo_i, jnp.maximum(hi_i, lo_i)
 
-    def min_inner_pos(doms, v0m, basem, okm):
-        """Min sink position > p0 within parallel iteration (v0m, basem)."""
-        offv = nt.ref_offset_at(sink_idx, v0m)
+    def min_inner_pos(doms, v0m, basem, okm, j):
+        """Min position of sink `j` > p0 within iteration (v0m, basem)."""
+        offv = nt.ref_offset_at(j, v0m)
         if lv == 0:
             pos = basem + offv
             return jnp.where(okm & (pos > p0), pos, INF)
@@ -420,8 +437,10 @@ def next_use_candidates_tri(nt: NestTrace, sink_idx: int, tid, p0, line, m0):
             jnp.where(ok_a, pos_a, INF), jnp.where(ok_b, pos_b, INF)
         )
 
-    def later_m_pos(doms, ok):
-        """Min sink position at any parallel iteration m' > m0.
+    def later_m_context(doms, ok):
+        """(v0, base, ok) of the earliest parallel iteration m' > m0
+        whose inner domains are nonempty — shared by every sink of the
+        group.
 
         Each inner domain is nonempty over an affine v0 halfspace
         intersection; the minimal valid m' is a count_below query.
@@ -463,27 +482,34 @@ def next_use_candidates_tri(nt: NestTrace, sink_idx: int, tid, p0, line, m0):
         m_ac = jnp.clip(m_a, 0, lmax)
         v0a = sched.local_to_value(tid, m_ac)
         ok_a = ok_a & (v0a >= vlo) & (v0a <= vhi)
-        return min_inner_pos(doms, v0a, base_of(m_ac), ok_a)
+        return v0a, base_of(m_ac), ok_a
 
-    best = jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+    bests = {
+        j: jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+        for j in sinks
+    }
     true_ = jnp.ones(jnp.shape(p0), dtype=bool)
 
     def emit(fixed_vals, ok):
-        nonlocal best
         doms = {l: v for l, v in fixed_vals.items() if l != 0}
         if 0 in fixed_vals:
             u0 = fixed_vals[0][1]
             n0 = u0 - start0
             okf = ok & (n0 >= 0) & (n0 < trip0)
             okf = okf & (sched.owner_tid(n0) == tid)
-            mf = jnp.clip(sched.local_index(n0), 0, lmax)
-            pos = min_inner_pos(doms, u0, base_of(mf), okf)
+            basef = base_of(jnp.clip(sched.local_index(n0), 0, lmax))
+            for j in sinks:
+                bests[j] = jnp.minimum(
+                    bests[j], min_inner_pos(doms, u0, basef, okf, j)
+                )
         else:
-            pos = jnp.minimum(
-                min_inner_pos(doms, v0_0, base_0, ok),
-                later_m_pos(doms, ok),
-            )
-        best = jnp.minimum(best, pos)
+            v0a, base_a, ok_a = later_m_context(doms, ok)
+            for j in sinks:
+                pos = jnp.minimum(
+                    min_inner_pos(doms, v0_0, base_0, ok, j),
+                    min_inner_pos(doms, v0a, base_a, ok_a, j),
+                )
+                bests[j] = jnp.minimum(bests[j], pos)
 
     _band_candidates(nt, sink_idx, line * W, W, true_, emit)
-    return best
+    return bests

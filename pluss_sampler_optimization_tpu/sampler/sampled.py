@@ -45,7 +45,7 @@ from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
 from ..ops.histogram import fixed_k_unique
 from ..runtime.hist import PRIState
-from .nextuse import INF, next_use_candidates
+from .nextuse import INF
 
 _RATIO_SLOTS = 16  # packed key = reuse * 16 + (ratio | noshare-slot 15)
 _NOSHARE_SLOT = _RATIO_SLOTS - 1
@@ -310,22 +310,42 @@ def _sample_geometry(nt: NestTrace, ref_idx: int, samples):
 
 
 def _best_sink(nt: NestTrace, ref_idx: int, tid, p0, line, m0):
-    """Min next-use position over same-array sink refs + argmin sink."""
-    from .nextuse import next_use_candidates_tri
+    """Min next-use position over same-array sink refs + argmin sink.
+
+    Sinks sharing one flat map (e.g. the read and write halves of an
+    accumulator statement) are solved as a group: the band candidates
+    and level specs are built once, each member pays only its own
+    position reduction.
+    """
+    from .nextuse import next_use_candidates_group, next_use_candidates_tri_group
 
     t = nt.tables
-    best = jnp.full_like(p0, INF.item())
-    best_sink = jnp.zeros_like(p0, dtype=jnp.int32)
+    groups: dict[tuple, list[int]] = {}
     for j in range(t.n_refs):
         if t.ref_arrays[j] != t.ref_arrays[ref_idx]:
             continue
+        key = (
+            int(t.ref_levels[j]),
+            tuple(int(c) for c in t.ref_coeffs[j]),
+            int(t.ref_consts[j]),
+        )
+        groups.setdefault(key, []).append(j)
+    best = jnp.full_like(p0, INF.item())
+    best_sink = jnp.zeros_like(p0, dtype=jnp.int32)
+    for sinks in groups.values():
         if nt.tri:
-            pj = next_use_candidates_tri(nt, j, tid, p0, line, m0)
+            bests = next_use_candidates_tri_group(
+                nt, tuple(sinks), tid, p0, line, m0
+            )
         else:
-            pj = next_use_candidates(nt, j, tid, p0, line)
-        take = pj < best
-        best = jnp.where(take, pj, best)
-        best_sink = jnp.where(take, jnp.int32(j), best_sink)
+            bests = next_use_candidates_group(
+                nt, tuple(sinks), tid, p0, line
+            )
+        for j in sinks:
+            pj = bests[j]
+            take = pj < best
+            best = jnp.where(take, pj, best)
+            best_sink = jnp.where(take, jnp.int32(j), best_sink)
     return best, best_sink
 
 
@@ -402,18 +422,19 @@ def warmup(
         )
 
 
-def _checkpoint_tag(program, machine, cfg, idx: int, name: str) -> str:
+def _checkpoint_tagger(program, machine, cfg):
+    """(idx, name) -> checkpoint tag; the program-structure hash (loops,
+    refs, thresholds — same-named programs can differ structurally,
+    e.g. gemm's share_threshold_variant) is computed once per run."""
     import hashlib
 
-    # hash the full program structure (loops, refs, thresholds), not
-    # just its name: same-named programs can differ structurally (e.g.
-    # gemm's share_threshold_variant)
     struct = hashlib.sha256(repr(program).encode()).hexdigest()[:16]
-    return (
+    prefix = (
         f"{program.name}/{struct}|{machine.thread_num},"
         f"{machine.chunk_size},{machine.ds},{machine.cls}|{cfg.ratio},"
-        f"{cfg.seed},{cfg.exclude_last_iteration}|{idx}|{name}"
+        f"{cfg.seed},{cfg.exclude_last_iteration}"
     )
+    return lambda idx, name: f"{prefix}|{idx}|{name}"
 
 
 def _checkpoint_load(path: str, tag: str):
@@ -477,13 +498,14 @@ def sampled_outputs(
     trace, kernels = _program_kernels(program, machine)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
+        tag_of = _checkpoint_tagger(program, machine, cfg)
     results = []
     for idx, (k, ri, kernel) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
         ck_path = ck_tag = None
         if checkpoint_dir is not None:
-            ck_tag = _checkpoint_tag(program, machine, cfg, idx, name)
+            ck_tag = tag_of(idx, name)
             ck_path = os.path.join(checkpoint_dir, f"ref_{idx:03d}.json")
             prior = _checkpoint_load(ck_path, ck_tag)
             if prior is not None:

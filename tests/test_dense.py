@@ -4,6 +4,7 @@ import pytest
 
 from pluss_sampler_optimization_tpu.config import MachineConfig
 from pluss_sampler_optimization_tpu.models import (
+    adi,
     atax,
     bicg,
     covariance,
@@ -48,6 +49,7 @@ PROGRAMS = [
     trmm(8, 11),
     trisolv(13),
     covariance(9, 7),
+    adi(9, tsteps=2),
 ]
 
 

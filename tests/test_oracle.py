@@ -4,6 +4,7 @@ import pytest
 
 from pluss_sampler_optimization_tpu.config import MachineConfig
 from pluss_sampler_optimization_tpu.models import (
+    adi,
     atax,
     bicg,
     covariance,
@@ -47,6 +48,7 @@ PROGRAMS = [
     trmm(8, 11),
     trisolv(13),  # zero-trip first iterations, diagonal ref
     covariance(9, 7),  # mixed rectangular + triangular nests
+    adi(9, tsteps=2),  # descending (step -1) inner loops
 ]
 
 

@@ -13,6 +13,7 @@ import pytest
 from pluss_sampler_optimization_tpu.config import MachineConfig, SamplerConfig
 from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
 from pluss_sampler_optimization_tpu.models import (
+    adi,
     atax,
     bicg,
     covariance,
@@ -86,6 +87,7 @@ PROGRAMS = [
     (syrk_tri(19, 5), None),
     (trmm(18, 4), None),
     (trisolv(21), None),
+    (adi(8), None),  # descending (step -1) backward-substitution loops
 ]
 
 
