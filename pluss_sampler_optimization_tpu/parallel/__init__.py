@@ -23,6 +23,7 @@ a `jax.sharding.Mesh`:
   XLA routes the psum over ICI within a slice and DCN across slices.
 """
 
+from .distributed import build_global_mesh, initialize_distributed
 from .mesh import build_mesh, local_device_count
 from .sharded import (
     run_dense_sharded,
@@ -32,6 +33,8 @@ from .sharded import (
 
 __all__ = [
     "build_mesh",
+    "build_global_mesh",
+    "initialize_distributed",
     "local_device_count",
     "run_sampled_sharded",
     "sampled_outputs_sharded",

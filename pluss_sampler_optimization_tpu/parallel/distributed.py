@@ -1,0 +1,92 @@
+"""Multi-host (multi-process) execution setup.
+
+The reference is single-node shared memory; its "communication backend"
+is mutexes and thread-local merges (SURVEY.md §2.4). Here the same mesh
+that scales across chips scales across hosts: `jax.distributed` wires
+the processes together, `jax.devices()` then spans every host's chips,
+and the 1-D sample mesh built over them makes the sampled engine's
+`lax.psum` ride ICI within a slice and DCN across slices — no engine
+code changes between one chip and a multi-host fleet.
+
+Typical launch (same program on every host):
+
+    from pluss_sampler_optimization_tpu.parallel import (
+        initialize_distributed, build_global_mesh,
+    )
+
+    initialize_distributed(coordinator, num_processes, process_id)
+    mesh = build_global_mesh()
+    state, results = run_sampled_sharded(prog, machine, cfg, mesh)
+
+Every host draws the same deterministic sample batch but ships only
+the rows its own devices hold (jax.make_array_from_process_local_data
+in parallel/sharded.py); kernel outputs are fully replicated — the
+dense histograms by psum, the exact (reuse, count) pairs by an
+in-graph all_gather — so every host decodes identical results.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .mesh import SAMPLE_AXIS, build_mesh
+
+_init_args: Optional[tuple] = None
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Wire this process into a multi-host run (jax.distributed).
+
+    With no arguments, relies on the cluster environment's
+    auto-detection (TPU pods populate it); a degenerate single-process
+    setup needs no call at all. Idempotent for a REPEATED identical
+    call; a re-call with a different topology raises instead of
+    silently keeping the first one.
+    """
+    global _init_args
+    args = (coordinator_address, num_processes, process_id)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _init_args = args
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
+            raise
+        if _init_args != args and args != (None, None, None):
+            # initialized before with a different topology (or outside
+            # this wrapper entirely, so the topology is unverifiable):
+            # an explicit conflicting request must not silently no-op
+            raise ValueError(
+                f"jax.distributed already initialized "
+                f"({'with ' + repr(_init_args) if _init_args else 'externally'}); "
+                f"conflicting re-initialization {args}"
+            ) from e
+
+
+def build_global_mesh(axis: str = SAMPLE_AXIS) -> jax.sharding.Mesh:
+    """1-D mesh over every device of every participating process.
+
+    After initialize_distributed, jax.devices() is the global list
+    ordered by process, so this is build_mesh() — named separately to
+    document intent at call sites and to assert the precondition that
+    each process contributes the same device count (required for the
+    equal per-process input shards of the multi-host dispatch).
+    """
+    n_local = jax.local_device_count()
+    n_total = len(jax.devices())
+    if n_total != n_local * jax.process_count():
+        raise RuntimeError(
+            f"unequal device counts across processes: {n_total} global "
+            f"!= {n_local} local x {jax.process_count()} processes"
+        )
+    return build_mesh(axis=axis)

@@ -80,16 +80,26 @@ def _build_sharded_ref_kernel(
         nosh_hist = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
         nosh_hist = jax.lax.psum(nosh_hist, axis)
         cold = jax.lax.psum(jnp.sum((~found & w).astype(jnp.int64)), axis)
-        # exact output: per-device unique (reuse, class) pairs
+        # exact output: per-device unique (reuse, class) pairs,
+        # all-gathered so every output is fully replicated — a few KB
+        # over ICI, and the one thing that makes multi-host fetch work
+        # (device_get of an axis-sharded output would touch
+        # non-addressable devices on other hosts)
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
-        return nosh_hist, cold, keys, counts, n_unique[None]
+        keys = jax.lax.all_gather(keys, axis)  # (n_dev, capacity)
+        counts = jax.lax.all_gather(counts, axis)
+        n_u = jax.lax.all_gather(n_unique, axis)  # (n_dev,)
+        return nosh_hist, cold, keys, counts, n_u
 
     def entry(sample_keys, n_valid, highs: tuple):
         return jax.shard_map(
             functools.partial(local_fn, highs=highs),
             mesh=mesh,
             in_specs=(P(axis), P()),
-            out_specs=(P(), P(), P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(), P(), P(), P()),
+            # all_gather outputs ARE replicated, but the static
+            # varying-axes check cannot infer that
+            check_vma=False,
         )(sample_keys, n_valid)
 
     return jax.jit(entry, static_argnames=("highs",))
@@ -155,12 +165,24 @@ def sampled_outputs_sharded(
         cold = 0.0
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
+        n_proc = jax.process_count()
+        in_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         for s0 in range(0, n_samples, step):
             chunk, n_valid = pad_keys(
                 keys_all[s0 : s0 + step], n_dev,
                 total=step if n_samples > step else None,
             )
-            cj = jnp.asarray(chunk)
+            # every process draws the same batch (deterministic host
+            # RNG) and ships only the rows its own devices hold; jax
+            # assembles the global sharded array. One path for any
+            # process count — single-process degenerates to the full
+            # chunk, already pre-sharded for the kernel.
+            rows = len(chunk) // n_proc
+            pid = jax.process_index()
+            cj = jax.make_array_from_process_local_data(
+                in_sharding, chunk[pid * rows : (pid + 1) * rows],
+                chunk.shape,
+            )
             while True:
                 nh, c, keys, counts, n_unique = jax.device_get(
                     kernel(cj, n_valid, tuple(highs))
@@ -177,8 +199,6 @@ def sampled_outputs_sharded(
                     nt, ri, mesh, cap, cfg.use_pallas_hist
                 )
                 kernels[idx][2:] = [kernel, cap]
-            keys = keys.reshape(n_dev, cap)
-            counts = counts.reshape(n_dev, cap)
             dense += nh
             cold += float(c)
             for d in range(n_dev):

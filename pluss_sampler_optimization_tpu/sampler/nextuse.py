@@ -42,7 +42,9 @@ import numpy as np
 
 from ..core.trace import NestTrace
 
-INF = jnp.int64(2**62)
+# plain int, not a jnp scalar: module import must not initialize a
+# backend (jax.distributed.initialize requires none exists yet)
+INF = 2**62
 
 
 def _cdiv(a, b):
@@ -321,7 +323,7 @@ def next_use_candidates_group(
         return specs
 
     bests = {
-        j: jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+        j: jnp.full(jnp.shape(p0), INF, dtype=jnp.int64)
         for j in sinks
     }
     true_ = jnp.ones(jnp.shape(p0), dtype=bool)
@@ -485,7 +487,7 @@ def next_use_candidates_tri_group(
         return v0a, base_of(m_ac), ok_a
 
     bests = {
-        j: jnp.full(jnp.shape(p0), INF.item(), dtype=jnp.int64)
+        j: jnp.full(jnp.shape(p0), INF, dtype=jnp.int64)
         for j in sinks
     }
     true_ = jnp.ones(jnp.shape(p0), dtype=bool)

@@ -330,7 +330,7 @@ def _best_sink(nt: NestTrace, ref_idx: int, tid, p0, line, m0):
             int(t.ref_consts[j]),
         )
         groups.setdefault(key, []).append(j)
-    best = jnp.full_like(p0, INF.item())
+    best = jnp.full_like(p0, INF)
     best_sink = jnp.zeros_like(p0, dtype=jnp.int32)
     for sinks in groups.values():
         if nt.tri:

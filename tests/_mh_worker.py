@@ -1,0 +1,53 @@
+"""Worker process for the true multi-host test (2 jax processes, 4
+virtual CPU devices each, gloo collectives). Run by test_parallel.py.
+
+Must configure the platform BEFORE jax.distributed comes up, and
+jax.distributed BEFORE any backend initializes — which the package
+guarantees by never creating device values at import time.
+"""
+
+import json
+import os
+import sys
+
+coord, n_proc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from pluss_sampler_optimization_tpu.config import (  # noqa: E402
+    MachineConfig,
+    SamplerConfig,
+)
+from pluss_sampler_optimization_tpu.models import gemm  # noqa: E402
+from pluss_sampler_optimization_tpu.parallel import (  # noqa: E402
+    build_global_mesh,
+    initialize_distributed,
+    run_sampled_sharded,
+)
+
+initialize_distributed(coord, n_proc, pid)
+mesh = build_global_mesh()
+assert mesh.devices.size == 4 * n_proc, mesh.devices.size
+state, results = run_sampled_sharded(
+    gemm(16), MachineConfig(), SamplerConfig(ratio=0.3, seed=0), mesh
+)
+out = [
+    {
+        "name": r.name,
+        "noshare": {str(k): v for k, v in r.noshare.items()},
+        "share": {
+            str(k): {str(a): b for a, b in h.items()}
+            for k, h in r.share.items()
+        },
+        "cold": r.cold,
+        "n": r.n_samples,
+    }
+    for r in results
+]
+print("RESULT" + str(pid) + "=" + json.dumps(out, sort_keys=True))
