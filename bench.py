@@ -5,7 +5,8 @@ Protocol (BASELINE.md): the reference's "speed" harness times sampler
 wall clock (c_lib/test/Makefile:34-37); its sampled r10 variant is
 measured against the serial full-traversal C++ sampler. Here:
 
-- workload: GEMM N (default 1024), THREAD_NUM=4, CHUNK=4, DS=8, CLS=64
+- workload: GEMM N (default 4096, the north-star config), THREAD_NUM=4,
+  CHUNK=4, DS=8, CLS=64
   — the reference machine model at scale;
 - ours: the vectorized random-start sampled engine (ratio 10%) on the
   default JAX device (one TPU chip under the driver), timed after a
@@ -78,7 +79,9 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, float]:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=1024)
+    # default = the north-star config (BASELINE.json: GEMM N=4096);
+    # its serial baseline ships recorded in baselines/
+    ap.add_argument("--n", type=int, default=4096)
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--device-timeout", type=float, default=240.0,
