@@ -1,0 +1,59 @@
+"""Virtual multi-device CPU platform pinning.
+
+This environment's sitecustomize registers an experimental accelerator
+PJRT plugin and pins JAX_PLATFORMS to it in every interpreter; its
+client init can hang, and env-var overrides are too late once jax is
+imported. Backend creation is lazy, though: overriding the
+jax_platforms *config* before the first computation reliably selects
+CPU, and XLA_FLAGS is read when the CPU client is created, which also
+hasn't happened yet.
+
+Single source of truth for the pinning recipe — used by both
+tests/conftest.py and the driver's __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Pin jax to a virtual ``n_devices``-device CPU platform.
+
+    Must run before any jax backend touch. Raises RuntimeError if a
+    backend already exists on another platform or exposes fewer
+    devices than requested (the caller would otherwise silently
+    validate nothing).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--{_FLAG}={n_devices}"
+    if _FLAG in flags:
+        # A stale value (e.g. a smaller count from the outer env) must
+        # be rewritten, not kept — the CPU client honours whatever
+        # number is in the string when it comes up.
+        flags = re.sub(rf"--?{_FLAG}=\d+", opt, flags)
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        raise RuntimeError(
+            f"requested a virtual CPU mesh but jax is on platform "
+            f"{platform!r}; a backend was initialized before "
+            "force_virtual_cpu could pin the platform"
+        )
+    if jax.local_device_count() < n_devices:
+        raise RuntimeError(
+            f"virtual CPU mesh wants {n_devices} devices but jax sees "
+            f"{jax.local_device_count()}; the CPU client was created "
+            f"before force_virtual_cpu could set --{_FLAG}"
+        )

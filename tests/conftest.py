@@ -14,17 +14,15 @@ is read when the CPU client is created, which also hasn't happened yet.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from pluss_sampler_optimization_tpu._platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compile cache: the suite's wall time is dominated by
 # jit compiles (sharded sampled kernels especially); the cache is
