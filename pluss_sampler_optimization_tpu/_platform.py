@@ -30,12 +30,12 @@ def force_virtual_cpu(n_devices: int) -> None:
     """
     flags = os.environ.get("XLA_FLAGS", "")
     opt = f"--{_FLAG}={n_devices}"
-    if _FLAG in flags:
+    pat = re.compile(rf"--?{_FLAG}=\S*")
+    if pat.search(flags):
         # A stale value (e.g. a smaller count from the outer env) must
         # be rewritten, not kept — the CPU client honours whatever
         # number is in the string when it comes up.
-        flags = re.sub(rf"--?{_FLAG}=\d+", opt, flags)
-        os.environ["XLA_FLAGS"] = flags
+        os.environ["XLA_FLAGS"] = pat.sub(opt, flags)
     else:
         os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
