@@ -51,26 +51,54 @@ def initialize_distributed(
     """
     global _init_args
     args = (coordinator_address, num_processes, process_id)
+    if _is_initialized():
+        # Decide idempotency from state, not from parsing the wording
+        # of jax's "already initialized" error (which may change
+        # between versions): a repeated identical call — or a bare
+        # auto-detect call — is a no-op; an explicit conflicting
+        # topology must not silently keep the first one.
+        if _init_args == args or args == (None, None, None):
+            return
+        raise ValueError(
+            f"jax.distributed already initialized "
+            f"({'with ' + repr(_init_args) if _init_args else 'externally'}); "
+            f"conflicting re-initialization {args}"
+        )
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-        _init_args = args
-    except RuntimeError as e:
-        msg = str(e).lower()
-        if "already" not in msg and "once" not in msg:
+    except RuntimeError:
+        # Backstop for when _is_initialized's internal probe is
+        # unavailable (jax._src layout changed) and the cluster was
+        # wired up outside this wrapper: the bare auto-detect call is
+        # tolerant by contract, so treat jax's "already initialized"
+        # complaint as a no-op rather than crashing the run. Explicit
+        # topologies still re-raise — a conflict must not silently
+        # keep the first winner.
+        if args != (None, None, None):
             raise
-        if _init_args != args and args != (None, None, None):
-            # initialized before with a different topology (or outside
-            # this wrapper entirely, so the topology is unverifiable):
-            # an explicit conflicting request must not silently no-op
-            raise ValueError(
-                f"jax.distributed already initialized "
-                f"({'with ' + repr(_init_args) if _init_args else 'externally'}); "
-                f"conflicting re-initialization {args}"
-            ) from e
+        return
+    _init_args = args
+
+
+def _is_initialized() -> bool:
+    """Whether this process already joined a jax.distributed cluster.
+
+    jax exposes no public predicate; the distributed client handle on
+    the global state object is the stable internal one (non-None after
+    a successful initialize, reset to None by shutdown). If the
+    internal layout ever changes, fall back to this wrapper's own
+    record so repeated identical calls through it stay idempotent.
+    """
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        return _init_args is not None
 
 
 def build_global_mesh(axis: str = SAMPLE_AXIS) -> jax.sharding.Mesh:

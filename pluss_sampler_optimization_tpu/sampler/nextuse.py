@@ -194,6 +194,13 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
     return out
 
 
+# Per-level candidate cap for _band_candidates. Well-separated strides
+# (the whole PolyBench family: n^2, n, 1, ...) give n_u <= W/c + 3, i.e.
+# single digits at the default W=8; anything past this cap means the
+# head coefficient does not dominate and the enumeration is not O(1).
+_MAX_BAND_CANDIDATES = 128
+
+
 def _ref_vars(nt: NestTrace, ref_idx: int):
     """Nonzero (level, coeff) terms of a ref's flat map, coeff descending.
 
@@ -254,6 +261,20 @@ def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
         u_min = _cdiv(lo_cur - r_max, c)
         u_max = (lo_cur + W - 1 - r_min) // c
         n_u = (W - 1 + (r_max - r_min)) // c + 2  # static bound
+        if n_u > _MAX_BAND_CANDIDATES:
+            # O(1) only holds when the head coefficient dominates the
+            # residual span (true for row-major affine maps, strides
+            # n^2 > n > 1). Two comparable coefficients (e.g. flat =
+            # i + j) would make n_u O(trip), silently unrolling
+            # thousands of emit() calls into the traced graph; fail
+            # fast like the negative-stride gate in _ref_vars instead.
+            raise NotImplementedError(
+                f"ref {nt.tables.ref_names[sink_idx]}: head stride {c} "
+                f"does not dominate the residual span "
+                f"[{r_min}, {r_max}] ({n_u} band candidates > cap "
+                f"{_MAX_BAND_CANDIDATES}); no O(1) closed-form band "
+                "enumeration for this flat map"
+            )
         for iu in range(n_u):
             u = u_min + iu
             recurse(rest, lo_cur - c * u, ok & (u <= u_max),

@@ -422,6 +422,13 @@ def warmup(
         )
 
 
+# Bump whenever the engine's RESULT semantics change (packing, share
+# thresholds, histogram encoding, ...): the version is folded into every
+# checkpoint tag, so stale files from an older engine are recomputed
+# instead of silently reused — the tag otherwise only captures inputs.
+_CHECKPOINT_SCHEMA = 2
+
+
 def _checkpoint_tagger(program, machine, cfg):
     """(idx, name) -> checkpoint tag; the program-structure hash (loops,
     refs, thresholds — same-named programs can differ structurally,
@@ -430,7 +437,7 @@ def _checkpoint_tagger(program, machine, cfg):
 
     struct = hashlib.sha256(repr(program).encode()).hexdigest()[:16]
     prefix = (
-        f"{program.name}/{struct}|{machine.thread_num},"
+        f"v{_CHECKPOINT_SCHEMA}|{program.name}/{struct}|{machine.thread_num},"
         f"{machine.chunk_size},{machine.ds},{machine.cls}|{cfg.ratio},"
         f"{cfg.seed},{cfg.exclude_last_iteration}"
     )

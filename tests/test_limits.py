@@ -1,0 +1,136 @@
+"""Regression tests for the documented model-family limits.
+
+Each cap in README.md's "Model-family limits" table must fail fast with
+a clean, named error — never silently truncate, mis-solve, or unroll an
+unbounded traced graph. One test per guard site.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pluss_sampler_optimization_tpu import (
+    Loop,
+    MachineConfig,
+    ParallelNest,
+    Program,
+    Ref,
+    SamplerConfig,
+)
+from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+
+
+def test_depth_cap():
+    with pytest.raises(ValueError, match="depth is 1..3"):
+        ParallelNest(
+            loops=(Loop(4), Loop(4), Loop(4), Loop(4)),
+            refs=(Ref("A0", "A", level=0, coeffs=(1,)),),
+        )
+    with pytest.raises(ValueError, match=r"level must be in \[0,3\)"):
+        Ref("A0", "A", level=3, coeffs=(1, 1, 1, 1))
+
+
+def test_parallel_loop_must_be_rectangular():
+    # doubly-triangular nests (lu/cholesky) are out of scope: the
+    # parallel loop's own bounds may not depend on anything
+    with pytest.raises(ValueError, match="parallel loop must be rectangular"):
+        ParallelNest(
+            loops=(Loop(8, trip_coeff=-1), Loop(8)),
+            refs=(Ref("A0", "A", level=1, coeffs=(8, 1)),),
+        )
+
+
+def test_negative_stride_rejected():
+    prog = Program(
+        name="negstride",
+        nests=(
+            ParallelNest(
+                loops=(Loop(8), Loop(8)),
+                refs=(Ref("A0", "A", level=1, coeffs=(8, -1), const=7),),
+            ),
+        ),
+    )
+    with pytest.raises(NotImplementedError, match="negative stride"):
+        run_sampled(prog, MachineConfig(), SamplerConfig(ratio=0.5, seed=0))
+
+
+def test_band_candidate_cap():
+    # flat = i + j: comparable coefficients; the head stride does not
+    # dominate the residual span, so the band enumeration would be
+    # O(trip) instead of O(1) — must raise, not unroll ~260 candidates
+    # into the traced graph
+    n = 256
+    prog = Program(
+        name="antidiag",
+        nests=(
+            ParallelNest(
+                loops=(Loop(n), Loop(n)),
+                refs=(Ref("A0", "A", level=1, coeffs=(1, 1)),),
+            ),
+        ),
+    )
+    with pytest.raises(NotImplementedError, match="does not dominate"):
+        run_sampled(prog, MachineConfig(), SamplerConfig(ratio=0.01, seed=0))
+
+
+def test_share_ratio_radix_cap():
+    # share ratio defaults to thread_num-1. The sampled engine packs
+    # (reuse, slot) with radix 16 (slot 15 = the noshare marker, so
+    # ratio < 15); the dense engine's packed key uses radix 8
+    from pluss_sampler_optimization_tpu.models.gemm import gemm
+    from pluss_sampler_optimization_tpu.sampler.dense import run_dense
+
+    with pytest.raises(NotImplementedError, match="share ratio"):
+        run_sampled(
+            gemm(32), MachineConfig(thread_num=16),
+            SamplerConfig(ratio=0.2, seed=0),
+        )
+    with pytest.raises(NotImplementedError, match="share ratio"):
+        run_dense(gemm(16), MachineConfig(thread_num=9))
+
+
+def test_triangular_nonunit_step_sampled_engine():
+    prog = Program(
+        name="tri-step2",
+        nests=(
+            ParallelNest(
+                loops=(Loop(8), Loop(8, step=2, trip_coeff=-1)),
+                refs=(Ref("A0", "A", level=1, coeffs=(8, 1)),),
+            ),
+        ),
+    )
+    with pytest.raises(NotImplementedError, match="unit steps only"):
+        run_sampled(prog, MachineConfig(), SamplerConfig(ratio=0.5, seed=0))
+
+
+def test_negative_element_index_rejected():
+    from pluss_sampler_optimization_tpu.sampler.dense import run_dense
+
+    prog = Program(
+        name="negaddr",
+        nests=(
+            ParallelNest(
+                loops=(Loop(8), Loop(8)),
+                refs=(Ref("A0", "A", level=1, coeffs=(8, 1), const=-4),),
+            ),
+        ),
+    )
+    with pytest.raises(NotImplementedError, match="negative"):
+        run_dense(prog, MachineConfig())
+
+
+def test_rect_models_within_band_cap():
+    """The whole shipped model family stays under the band-candidate cap
+    (the guard must never fire for supported programs). The guard only
+    runs inside the per-ref classification kernels, so actually run the
+    sampled engine, not just trace construction."""
+    from pluss_sampler_optimization_tpu.models.gemm import gemm
+    from pluss_sampler_optimization_tpu.models.jacobi2d import jacobi2d
+    from pluss_sampler_optimization_tpu.models.mm2 import mm2
+
+    for prog in (gemm(128), mm2(24), jacobi2d(24)):
+        _, results = run_sampled(
+            prog, MachineConfig(), SamplerConfig(ratio=0.02, seed=0)
+        )
+        assert sum(r.n_samples for r in results) > 0

@@ -104,9 +104,7 @@ def _all_points(nt, ri):
     return np.array(pts, dtype=np.int64).reshape(len(pts), lv + 1)
 
 
-@pytest.mark.parametrize("program,_", PROGRAMS, ids=lambda p: getattr(p, "name", ""))
-def test_exhaustive_next_use(program, _):
-    machine = MachineConfig()
+def _check_exhaustive_next_use(program, machine):
     trace = ProgramTrace(program, machine)
     for k, nt in enumerate(trace.nests):
         t = nt.tables
@@ -131,6 +129,40 @@ def test_exhaustive_next_use(program, _):
                     f"nest {k} ref {t.ref_names[ri]} sample "
                     f"{samples[s].tolist()}: got {int(ri_got[s])}, want {want}"
                 )
+
+
+@pytest.mark.parametrize("program,_", PROGRAMS, ids=lambda p: getattr(p, "name", ""))
+def test_exhaustive_next_use(program, _):
+    _check_exhaustive_next_use(program, MachineConfig())
+
+
+# The triangular solver's schedule arithmetic (count_below ownership,
+# later_m_context round-robin gathers) bakes thread_num/chunk_size into
+# every closed form; the default 4x4 machine hides divisibility bugs, so
+# the triangular family is re-checked under odd geometries (the dense
+# and oracle engines already have odd-machine triangular tests).
+ODD_MACHINES = [
+    MachineConfig(thread_num=3, chunk_size=5),
+    MachineConfig(thread_num=5, chunk_size=2),
+]
+TRI_PROGRAMS = [
+    syrk_tri(9),
+    syrk_tri(17, 4),  # trip0 > chunk*threads under both odd machines
+    trmm(8),
+    trisolv(13),
+    covariance(8, 6),
+    adi(8),
+]
+
+
+@pytest.mark.parametrize(
+    "machine", ODD_MACHINES, ids=lambda m: f"t{m.thread_num}c{m.chunk_size}"
+)
+@pytest.mark.parametrize(
+    "program", TRI_PROGRAMS, ids=lambda p: getattr(p, "name", "")
+)
+def test_exhaustive_next_use_odd_machines(program, machine):
+    _check_exhaustive_next_use(program, machine)
 
 
 def test_sampled_gemm128_counts():
