@@ -67,10 +67,10 @@ def _run_engine(engine: str, program, machine, args):
     if engine in ("sampled", "sharded"):
         from .config import SamplerConfig
 
-        cfg = SamplerConfig(
-            ratio=args.ratio, seed=args.seed,
-            use_pallas_hist=args.pallas_hist,
-        )
+        kw = {}
+        if args.pallas_hist is not None:  # None = keep config default
+            kw["use_pallas_hist"] = args.pallas_hist
+        cfg = SamplerConfig(ratio=args.ratio, seed=args.seed, **kw)
         v2 = args.runtime == "v2"
         if engine == "sampled":
             from .sampler.sampled import run_sampled
@@ -117,9 +117,12 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--pallas-hist", action="store_true",
+    ap.add_argument("--pallas-hist", default=None,
+                    action=argparse.BooleanOptionalAction,
                     help="sharded engine: reduce histograms with the "
-                    "Pallas TPU kernel instead of XLA scatter-add")
+                    "Pallas TPU kernel (the config default; it falls "
+                    "back to portable scatter-add off-TPU); "
+                    "--no-pallas-hist forces scatter-add everywhere")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
     ap.add_argument("--min-reuse", type=int, default=512,

@@ -79,8 +79,10 @@ class SamplerConfig:
     max_share_values: int = 64
     # Use the Pallas comparison-ladder histogram kernel
     # (ops/pallas_hist.py) for the sharded engine's dense noshare
-    # reduction; dispatches to the kernel only on a TPU backend.
-    use_pallas_hist: bool = False
+    # reduction. On by default: the dispatcher routes to the kernel
+    # only on a TPU backend and to the portable exp_hist elsewhere,
+    # so the flag is a TPU opt-OUT, not a portability risk.
+    use_pallas_hist: bool = True
 
     def num_samples(self, trips) -> int:
         import math

@@ -71,17 +71,30 @@ def initialize_distributed(
             process_id=process_id,
         )
     except RuntimeError:
-        # Backstop for when _is_initialized's internal probe is
-        # unavailable (jax._src layout changed) and the cluster was
-        # wired up outside this wrapper: the bare auto-detect call is
-        # tolerant by contract, so treat jax's "already initialized"
-        # complaint as a no-op rather than crashing the run. Explicit
-        # topologies still re-raise — a conflict must not silently
-        # keep the first winner.
-        if args != (None, None, None):
+        # Backstop for when the internal client probe is UNAVAILABLE
+        # (jax._src layout changed) and the cluster was wired up
+        # outside this wrapper: the bare auto-detect call is tolerant
+        # by contract, so treat jax's "already initialized" complaint
+        # as a no-op rather than crashing the run. When the probe IS
+        # available it already answered "not initialized" above, so
+        # this RuntimeError is a genuine init failure — re-raise.
+        # Explicit topologies always re-raise.
+        if args != (None, None, None) or _probe_client() is not None:
             raise
         return
     _init_args = args
+
+
+def _probe_client():
+    """The distributed client handle, or None when the internal API is
+    unavailable (jax._src layout changed). Returns a (client-or-None,)
+    tuple so callers can distinguish "no client" from "can't tell"."""
+    try:
+        from jax._src.distributed import global_state
+
+        return (global_state.client,)
+    except Exception:
+        return None
 
 
 def _is_initialized() -> bool:
@@ -93,12 +106,10 @@ def _is_initialized() -> bool:
     internal layout ever changes, fall back to this wrapper's own
     record so repeated identical calls through it stay idempotent.
     """
-    try:
-        from jax._src.distributed import global_state
-
-        return global_state.client is not None
-    except Exception:
-        return _init_args is not None
+    probed = _probe_client()
+    if probed is not None:
+        return probed[0] is not None
+    return _init_args is not None
 
 
 def build_global_mesh(axis: str = SAMPLE_AXIS) -> jax.sharding.Mesh:

@@ -56,6 +56,25 @@ def test_sample_mode_writes_mrc(tmp_path, capsys):
     assert lines[1].startswith("0, 1")
 
 
+def test_sample_mode_sharded_multidevice(capsys):
+    """The user-facing sharded entry on a real multi-device mesh.
+
+    The library path (run_sampled_sharded) has 8-device coverage in
+    test_parallel.py; this pins the CLI flow — argument plumbing,
+    build_mesh() over every visible device, dump emission — so it
+    cannot regress separately. Dumps must match the single-device
+    sampled engine byte for byte."""
+    import jax
+
+    assert jax.device_count() == 8  # the conftest virtual CPU mesh
+    args = ["sample", "--model", "gemm", "--n", "16", "--ratio", "0.3"]
+    out_sharded = _dump(capsys, args + ["--engine", "sharded"])
+    out_sampled = _dump(capsys, args + ["--engine", "sampled"])
+    assert out_sharded == out_sampled
+    # the CLI's own diff harness agrees
+    _dump(capsys, args + ["--engine", "sharded", "--diff-against", "sampled"])
+
+
 def test_all_models_build(capsys):
     from pluss_sampler_optimization_tpu.models import REGISTRY
 
