@@ -82,6 +82,33 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, list]:
     except OSError:
         pass
 
+    evidence: list = []
+    # The accelerator plugin in this environment dials a loopback relay
+    # (pool IPs from the env); a dead relay means jax.devices() blocks
+    # forever in the claim loop. A 2s TCP check per service port turns
+    # "the probe timed out" into "nothing is listening at the relay" —
+    # the difference between a mystery and a root cause.
+    pool_ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if pool_ips:
+        import socket
+
+        t0 = time.perf_counter()
+        reach = {}
+        # first IP only, 1s per port: worst case 3s, charged against
+        # the budget below so the flag's contract holds
+        ip = pool_ips.split(",")[0].strip()
+        for port in (8081, 8082, 8083):
+            try:
+                with socket.create_connection((ip, port), 1):
+                    reach[f"{ip}:{port}"] = "open"
+            except OSError as e:
+                reach[f"{ip}:{port}"] = type(e).__name__
+        scan_s = time.perf_counter() - t0
+        evidence.append(
+            {"relay_tcp": reach, "seconds": round(scan_s, 1)}
+        )
+        timeout_s = max(1.0, timeout_s - scan_s)
+
     # ~1/4 of the budget for a quick first look, the rest for one long
     # patient attempt (slow-but-alive tunnels need minutes to init).
     # The total never exceeds timeout_s — that is the flag's contract.
@@ -89,7 +116,6 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, list]:
     schedule = [first]
     if timeout_s - first > 1.0:
         schedule.append(timeout_s - first)
-    evidence = []
     ok = False
     for i, t_limit in enumerate(schedule):
         t0 = time.perf_counter()
@@ -287,10 +313,11 @@ def main() -> int:
         extra["hist_kernel"] = _bench_hist_kernel_on_device()
 
     if device_fallback:
-        probe_s = sum(e.get("seconds", 0.0) for e in probe_evidence)
+        attempts = [e for e in probe_evidence if "attempt" in e]
+        probe_s = sum(e.get("seconds", 0.0) for e in attempts)
         extra["device_fallback"] = (
             f"accelerator backend did not initialize within "
-            f"{args.device_timeout:.0f}s across {len(probe_evidence)} "
+            f"{args.device_timeout:.0f}s across {len(attempts)} "
             f"attempts (total probe {probe_s:.0f}s); ran on CPU"
         )
         extra["probe"] = probe_evidence
@@ -335,7 +362,12 @@ def main() -> int:
 
     # Second model, sampled engine vs live native serial: evidence that
     # the IR-generic engine's throughput story is not GEMM-specific.
-    if args.second_model and args.second_model in REGISTRY:
+    if args.second_model and args.second_model not in REGISTRY:
+        raise SystemExit(
+            f"--second-model {args.second_model!r} is not a model "
+            f"(known: {', '.join(sorted(REGISTRY))})"
+        )
+    if args.second_model:
         sprog = REGISTRY[args.second_model](args.second_n)
         try:
             warmup(sprog, machine, cfg)

@@ -57,7 +57,7 @@ from .mesh import build_mesh
 
 def _build_sharded_ref_kernel(
     nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int,
-    use_pallas_hist: bool = True,
+    use_pallas_hist: bool,
 ):
     """jit(shard_map) kernel: sharded samples -> reduced histograms."""
     axis = mesh.axis_names[0]
@@ -111,7 +111,7 @@ def _sharded_program_kernels(
     machine: MachineConfig,
     mesh: jax.sharding.Mesh,
     capacity: int,
-    use_pallas_hist: bool = True,
+    use_pallas_hist: bool,
 ):
     trace = ProgramTrace(program, machine)
     kernels = []

@@ -120,6 +120,23 @@ def test_negative_element_index_rejected():
         run_dense(prog, MachineConfig())
 
 
+def test_position_width_audit():
+    """Pins the int32/int64 crossover README documents: GEMM per-thread
+    trace positions fit int32 through N=1024 and overflow it by N=2048,
+    so jax_enable_x64 is a correctness requirement at north-star sizes."""
+    from pluss_sampler_optimization_tpu.models.gemm import gemm
+
+    def max_pos(n):
+        trace = ProgramTrace(gemm(n), MachineConfig())
+        return max(
+            trace.nests[0].tid_length(t)
+            for t in range(MachineConfig().thread_num)
+        )
+
+    assert max_pos(1024) < 2**31
+    assert max_pos(2048) > 2**31
+
+
 def test_rect_models_within_band_cap():
     """The whole shipped model family stays under the band-candidate cap
     (the guard must never fire for supported programs). The guard only
