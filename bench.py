@@ -89,13 +89,14 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, list]:
     # "the probe timed out" into "nothing is listening at the relay" —
     # the difference between a mystery and a root cause.
     pool_ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
-    if pool_ips:
+    # first IP only, 1s per port: worst case 3s, charged against the
+    # budget below (and skipped entirely when the budget is too small
+    # to absorb it) so the flag's contract holds
+    if pool_ips and timeout_s > 10.0:
         import socket
 
         t0 = time.perf_counter()
         reach = {}
-        # first IP only, 1s per port: worst case 3s, charged against
-        # the budget below so the flag's contract holds
         ip = pool_ips.split(",")[0].strip()
         for port in (8081, 8082, 8083):
             try:
@@ -257,6 +258,14 @@ def main() -> int:
     )
 
     machine = MachineConfig()
+    # validate every model name BEFORE the (possibly hour-long) runs —
+    # a typo in --second-model must not discard the headline metric
+    for name in filter(None, (args.model, args.second_model)):
+        if name not in REGISTRY:
+            raise SystemExit(
+                f"unknown model {name!r} "
+                f"(known: {', '.join(sorted(REGISTRY))})"
+            )
     prog = REGISTRY[args.model](args.n)
     cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
     t0 = time.perf_counter()
@@ -362,11 +371,6 @@ def main() -> int:
 
     # Second model, sampled engine vs live native serial: evidence that
     # the IR-generic engine's throughput story is not GEMM-specific.
-    if args.second_model and args.second_model not in REGISTRY:
-        raise SystemExit(
-            f"--second-model {args.second_model!r} is not a model "
-            f"(known: {', '.join(sorted(REGISTRY))})"
-        )
     if args.second_model:
         sprog = REGISTRY[args.second_model](args.second_n)
         try:

@@ -41,7 +41,7 @@ from ..ops.histogram import N_EXP_BINS, exp_hist, fixed_k_unique
 from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
 from ..sampler.sampled import (
-    DEFAULT_BATCH,
+    default_batch,
     DEFAULT_CAPACITY,
     SampledRefResult,
     check_packed_ratios,
@@ -138,13 +138,14 @@ def sampled_outputs_sharded(
     machine: MachineConfig,
     cfg: SamplerConfig | None = None,
     mesh: jax.sharding.Mesh | None = None,
-    batch: int = DEFAULT_BATCH,
+    batch: int | None = None,
     capacity: int = DEFAULT_CAPACITY,
 ):
     """Sharded sampled engine -> per-ref SampledRefResult (exact) plus
     the psum'd dense noshare histograms (per ref, for observability)."""
     cfg = cfg or SamplerConfig()
     mesh = mesh or build_mesh()
+    batch = batch or default_batch()
     n_dev = mesh.devices.size
     trace, kernels = _sharded_program_kernels(
         program, machine, mesh, capacity, cfg.use_pallas_hist

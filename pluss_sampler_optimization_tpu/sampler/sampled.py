@@ -50,10 +50,25 @@ from .nextuse import INF
 _RATIO_SLOTS = 16  # packed key = reuse * 16 + (ratio | noshare-slot 15)
 _NOSHARE_SLOT = _RATIO_SLOTS - 1
 
-# One source of truth for the dispatch geometry: warmup() compiles at
-# these exact values, so callers overriding one site must override both.
+# Accelerator per-dispatch sample count; entry points that take
+# batch=None resolve default_batch() at call time instead, so warmup()
+# and the run compile at the same shapes on every backend. Callers
+# overriding batch at one site must override it at both.
 DEFAULT_BATCH = 1 << 20
-DEFAULT_CAPACITY = 256
+
+
+def default_batch() -> int:
+    """Per-dispatch sample count. Batch-size sweeps (2^15..2^22, GEMM
+    N=2048) peak at 2^17 on the CPU backend — smaller working sets stay
+    in cache on a host core — while accelerators amortize dispatch
+    better at 2^20. Resolved at call time, after backend selection."""
+    return (1 << 17) if jax.default_backend() == "cpu" else DEFAULT_BATCH
+# Share-pair slots per dispatch. The PolyBench family yields a handful
+# of distinct (reuse, class) pairs per batch (GEMM: <= 3), so 64 keeps
+# fixed_k_unique on its 2-round fast path; a model that genuinely
+# exceeds it triggers the drain loop's regrow-and-recompile (4x) once,
+# not an error.
+DEFAULT_CAPACITY = 64
 
 
 @dataclasses.dataclass
@@ -145,21 +160,36 @@ def draw_sample_keys(
     # uniques would bias toward small keys). Triangular nests draw the
     # box and reject out-of-bounds points, which preserves uniformity
     # over the valid space.
+    #
+    # Keys are drawn directly in the flat mixed-radix space — one
+    # int64 uniform over prod(highs) IS the per-level composition, one
+    # rng call instead of depth calls (a ~2x draw-stage win measured
+    # at GEMM N=2048, where drawing was ~45% of engine wall time).
+    space = 1
+    for h in highs:
+        space *= h
+    assert space < 1 << 63, "sample space exceeds int64 keys"
     uniq = np.empty(0, dtype=np.int64)
     while len(uniq) < s:
         need = s - len(uniq)
-        batch_keys = rng.integers(0, highs[0], size=max(64, need + need // 8))
-        for h in highs[1:]:
-            batch_keys = batch_keys * h + rng.integers(
-                0, h, size=batch_keys.shape
-            )
+        batch_keys = rng.integers(0, space, size=max(64, need + need // 8))
         if tri:
             batch_keys = _tri_valid_keys(
                 nest_trace, ref_idx, batch_keys, highs, excl
             )
-        uniq = np.union1d(uniq, batch_keys)  # sorted unique union
+        uniq = (
+            np.unique(batch_keys) if len(uniq) == 0
+            else np.union1d(uniq, batch_keys)
+        )
     if len(uniq) > s:
-        uniq = rng.choice(uniq, size=s, replace=False)
+        # Thin by dropping the complement: (len-s) << s near the target
+        # margin, so indexing a uniform drop-set is much cheaper than
+        # materializing a permutation of the whole unique set, and a
+        # uniform (len-s)-drop leaves exactly a uniform s-subset.
+        drop = rng.choice(len(uniq), size=len(uniq) - s, replace=False)
+        keep = np.ones(len(uniq), dtype=bool)
+        keep[drop] = False
+        uniq = uniq[keep]
     return uniq, highs
 
 
@@ -395,7 +425,7 @@ def warmup(
     program: Program,
     machine: MachineConfig,
     cfg: SamplerConfig | None = None,
-    batch: int = DEFAULT_BATCH,
+    batch: int | None = None,
     capacity: int = DEFAULT_CAPACITY,
 ) -> None:
     """Compile every per-ref kernel at the exact shapes a subsequent
@@ -407,6 +437,7 @@ def warmup(
     capacity-regrow recompile (drain loop in sampled_outputs) lands in
     the subsequent run, a deliberately conservative accounting."""
     cfg = cfg or SamplerConfig()
+    batch = batch or default_batch()
     trace, kernels = _program_kernels(program, machine)
     for k, ri, kernel in kernels:
         nt = trace.nests[k]
@@ -423,10 +454,12 @@ def warmup(
 
 
 # Bump whenever the engine's RESULT semantics change (packing, share
-# thresholds, histogram encoding, ...): the version is folded into every
-# checkpoint tag, so stale files from an older engine are recomputed
-# instead of silently reused — the tag otherwise only captures inputs.
-_CHECKPOINT_SCHEMA = 2
+# thresholds, histogram encoding, seeded sample stream, ...): the
+# version is folded into every checkpoint tag, so stale files from an
+# older engine are recomputed instead of silently reused — the tag
+# otherwise only captures inputs. v3: flat-space key drawing changed
+# the per-seed sample sets.
+_CHECKPOINT_SCHEMA = 3
 
 
 def _checkpoint_tagger(program, machine, cfg):
@@ -486,7 +519,7 @@ def sampled_outputs(
     program: Program,
     machine: MachineConfig,
     cfg: SamplerConfig,
-    batch: int = DEFAULT_BATCH,
+    batch: int | None = None,
     capacity: int = DEFAULT_CAPACITY,
     checkpoint_dir: str | None = None,
 ):
@@ -502,6 +535,7 @@ def sampled_outputs(
     """
     import os
 
+    batch = batch or default_batch()
     trace, kernels = _program_kernels(program, machine)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
