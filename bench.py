@@ -220,6 +220,11 @@ def main() -> int:
                     help="extra sampled-engine metric on a second model "
                     "at --second-n ('' disables)")
     ap.add_argument("--second-n", type=int, default=512)
+    ap.add_argument("--skip-baseline", action="store_true",
+                    help="report throughput only, without measuring or "
+                    "loading the serial baseline (for configs whose "
+                    "serial run is infeasible, e.g. GEMM N=8192 at "
+                    "~19h of single-core time)")
     ap.add_argument("--device-timeout", type=float, default=240.0,
                     help="seconds to wait for the accelerator backend "
                     "before falling back to CPU (0 = trust it)")
@@ -336,38 +341,43 @@ def main() -> int:
     # run (tools/make_baseline.py -> baselines/) is preferred; absent
     # that, measure live.
     vs_baseline = 0.0
-    try:
-        from pluss_sampler_optimization_tpu.runtime.baseline import (
-            load_baseline,
-        )
-
+    if args.skip_baseline:
+        extra["baseline_skipped"] = True
+    else:
         try:
-            stored = load_baseline(args.model, args.n, machine)
-        except Exception as e:  # corrupt file: fall back to live measure
-            stored = None
-            extra["baseline_load_error"] = repr(e)
-        if stored is not None:
-            t_cpp = float(stored["serial_seconds"])
-            base_state = stored["state"]
-            extra["serial_accesses"] = int(stored["total_accesses"])
-            extra["serial_cpp_s_recorded"] = round(t_cpp, 4)
-        else:
-            from pluss_sampler_optimization_tpu import native
+            from pluss_sampler_optimization_tpu.runtime.baseline import (
+                load_baseline,
+            )
 
-            t0 = time.perf_counter()
-            base = native.run_serial_native(prog, machine)
-            t_cpp = time.perf_counter() - t0
-            base_state = base.state
-            extra["serial_accesses"] = base.total_accesses
-            extra["serial_cpp_s"] = round(t_cpp, 4)
-        vs_baseline = t_cpp / t_tpu
+            try:
+                stored = load_baseline(args.model, args.n, machine)
+            except Exception as e:  # corrupt: fall back to live measure
+                stored = None
+                extra["baseline_load_error"] = repr(e)
+            if stored is not None:
+                t_cpp = float(stored["serial_seconds"])
+                base_state = stored["state"]
+                extra["serial_accesses"] = int(stored["total_accesses"])
+                extra["serial_cpp_s_recorded"] = round(t_cpp, 4)
+            else:
+                from pluss_sampler_optimization_tpu import native
 
-        T = machine.thread_num
-        mrc_engine = aet_mrc(cri_distribute(state, T, T), machine)
-        mrc_serial = aet_mrc(cri_distribute(base_state, T, T), machine)
-        extra["mrc_l1_err"] = round(mrc_l1_error(mrc_engine, mrc_serial), 6)
-    except RuntimeError as e:  # no toolchain: report throughput only
-        extra["baseline_error"] = str(e)
+                t0 = time.perf_counter()
+                base = native.run_serial_native(prog, machine)
+                t_cpp = time.perf_counter() - t0
+                base_state = base.state
+                extra["serial_accesses"] = base.total_accesses
+                extra["serial_cpp_s"] = round(t_cpp, 4)
+            vs_baseline = t_cpp / t_tpu
+
+            T = machine.thread_num
+            mrc_engine = aet_mrc(cri_distribute(state, T, T), machine)
+            mrc_serial = aet_mrc(cri_distribute(base_state, T, T), machine)
+            extra["mrc_l1_err"] = round(
+                mrc_l1_error(mrc_engine, mrc_serial), 6
+            )
+        except RuntimeError as e:  # no toolchain: throughput only
+            extra["baseline_error"] = str(e)
 
     # Second model, sampled engine vs live native serial: evidence that
     # the IR-generic engine's throughput story is not GEMM-specific.

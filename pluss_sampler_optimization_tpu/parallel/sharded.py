@@ -145,7 +145,8 @@ def sampled_outputs_sharded(
     the psum'd dense noshare histograms (per ref, for observability)."""
     cfg = cfg or SamplerConfig()
     mesh = mesh or build_mesh()
-    batch = batch or default_batch()
+    if batch is None:
+        batch = default_batch()
     n_dev = mesh.devices.size
     trace, kernels = _sharded_program_kernels(
         program, machine, mesh, capacity, cfg.use_pallas_hist

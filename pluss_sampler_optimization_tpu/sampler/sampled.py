@@ -437,7 +437,8 @@ def warmup(
     capacity-regrow recompile (drain loop in sampled_outputs) lands in
     the subsequent run, a deliberately conservative accounting."""
     cfg = cfg or SamplerConfig()
-    batch = batch or default_batch()
+    if batch is None:
+        batch = default_batch()
     trace, kernels = _program_kernels(program, machine)
     for k, ri, kernel in kernels:
         nt = trace.nests[k]
@@ -535,7 +536,8 @@ def sampled_outputs(
     """
     import os
 
-    batch = batch or default_batch()
+    if batch is None:
+        batch = default_batch()
     trace, kernels = _program_kernels(program, machine)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)

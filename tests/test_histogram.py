@@ -69,6 +69,21 @@ def test_hash_unique_hostile_keys():
     assert int(na) == int(nb) == 4
 
 
+@pytest.mark.parametrize("k", [32, 64, 128, 256, 512])
+def test_hash_unique_adaptive_rounds_full_load(k):
+    """A full k-distinct load at every capacity tier (rounds resolves
+    2 below 64, 3 above) stays exact whether the hash rounds resolve
+    everything or the in-graph sorted fallback fires."""
+    rng = np.random.default_rng(k)
+    pool = rng.integers(0, 1 << 55, k)
+    vals = rng.choice(pool, 1 << 14)
+    valid = np.ones(len(vals), dtype=bool)
+    ka, ca, na = sorted_k_unique(vals, valid, k)
+    kb, cb, nb = fixed_k_unique(vals, valid, k)
+    assert int(na) == int(nb)
+    assert _as_dict(ka, ca) == _as_dict(kb, cb)
+
+
 def test_exp_hist_mass():
     vals = np.array([1, 2, 3, 8, 9, 1 << 40], dtype=np.int64)
     w = np.ones(len(vals), dtype=np.int64)
