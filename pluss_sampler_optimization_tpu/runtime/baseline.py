@@ -82,6 +82,7 @@ def save_baseline(
     total_accesses: int,
     state: PRIState,
     path: str | None = None,
+    conditions: dict | None = None,
 ) -> str:
     path = path or baseline_path(model, n, machine)
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -92,6 +93,9 @@ def save_baseline(
         "serial_seconds": serial_seconds,
         "total_accesses": total_accesses,
         "engine": "native-serial",
+        # measurement conditions (reps, per-rep times, load average):
+        # a recorded wall time without them is not reproducible
+        "conditions": conditions or {},
         "state": state_to_json(state),
     }
     with gzip.open(path, "wt") as f:

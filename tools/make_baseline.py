@@ -24,6 +24,10 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gemm")
     ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--reps", type=int, default=1,
+                    help="timed repetitions; the stored wall time is "
+                    "the median (the reference's speed mode runs 10; "
+                    "1 is the pragmatic default for hour-long configs)")
     args = ap.parse_args()
 
     import jax
@@ -38,14 +42,25 @@ def main() -> int:
 
     machine = MachineConfig()
     prog = REGISTRY[args.model](args.n)
-    flush_cache()  # the reference flushes before timing (pluss.cpp:71-94)
-    t0 = time.perf_counter()
-    res = run_serial_native(prog, machine)
-    secs = time.perf_counter() - t0
+    times = []
+    for _ in range(max(1, args.reps)):
+        flush_cache()  # reference flushes before timing (pluss.cpp:71-94)
+        t0 = time.perf_counter()
+        res = run_serial_native(prog, machine)
+        times.append(time.perf_counter() - t0)
+    secs = sorted(times)[len(times) // 2]
+    conditions = {
+        "reps": len(times),
+        "times_s": [round(t, 4) for t in times],
+        "cpus": os.cpu_count(),
+        "loadavg_1m": round(os.getloadavg()[0], 2),
+    }
     path = save_baseline(
-        args.model, args.n, machine, secs, res.total_accesses, res.state
+        args.model, args.n, machine, secs, res.total_accesses, res.state,
+        conditions=conditions,
     )
-    print(f"{path}: {secs:.1f}s, {res.total_accesses} accesses")
+    print(f"{path}: {secs:.1f}s median of {times}, "
+          f"{res.total_accesses} accesses, {conditions}")
     return 0
 
 
