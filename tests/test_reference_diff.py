@@ -49,9 +49,14 @@ static inline double gsl_ran_negative_binomial_pdf(unsigned int k, double p, dou
 _EMPTY_GUARD = "#ifndef GSL_STUB_{0}_H\n#define GSL_STUB_{0}_H\n#endif\n"
 
 
-@pytest.fixture(scope="session")
-def reference_binary(tmp_path_factory):
-    """Build (once, cached) the reference serial oracle sampler."""
+def _build_reference(tmp_path_factory, threads: int, chunk: int) -> str:
+    """Build (once, cached) the reference serial oracle sampler.
+
+    THREAD_NUM/CHUNK_SIZE are the reference's compile-time -D macros
+    (Makefile:14-15), so each machine geometry is its own binary —
+    which lets the diff anchor our schedule arithmetic against the
+    real reference at odd geometries too, not just the default 4x4.
+    """
     if not os.path.isdir(REF):
         pytest.skip("reference checkout not present")
     if shutil.which("g++") is None:
@@ -66,7 +71,8 @@ def reference_binary(tmp_path_factory):
     # irrelevant for a correctness diff). {build} is substituted below.
     cmd_tail = [
         "-std=c++17", "-O2", "-fopenmp", f"-I{REF}/runtime",
-        "-DTHREAD_NUM=4", "-DCHUNK_SIZE=4", "-DDS=8", "-DCLS=64",
+        f"-DTHREAD_NUM={threads}", f"-DCHUNK_SIZE={chunk}",
+        "-DDS=8", "-DCLS=64",
         *sources, "-lm",
     ]
     # Cache key covers the stub, the compile line, and the reference
@@ -78,7 +84,10 @@ def reference_binary(tmp_path_factory):
     for src in sources + [f"{REF}/runtime/pluss.h", f"{REF}/runtime/pluss_utils.h"]:
         with open(src, "rb") as f:
             h.update(f.read())
-    cached = os.path.join(_REPO, ".refbuild", f"ri-omp-seq-{h.hexdigest()[:12]}")
+    cached = os.path.join(
+        _REPO, ".refbuild",
+        f"ri-omp-seq-t{threads}c{chunk}-{h.hexdigest()[:12]}",
+    )
     if os.path.exists(cached):
         return cached
 
@@ -130,15 +139,25 @@ def _max_iterations(text: str) -> int:
     raise AssertionError("no max-iteration line found")
 
 
-def test_acc_dump_matches_reference(reference_binary):
+# default machine, plus odd geometries that stress the chunk/ownership
+# arithmetic (short last chunks, non-divisible thread counts)
+GEOMETRIES = [(4, 4), (3, 5), (8, 2)]
+
+
+@pytest.mark.parametrize(
+    "threads,chunk", GEOMETRIES, ids=lambda v: str(v)
+)
+def test_acc_dump_matches_reference(tmp_path_factory, threads, chunk):
+    binary = _build_reference(tmp_path_factory, threads, chunk)
     ref = subprocess.run(
-        [reference_binary, "acc"], capture_output=True, text=True, timeout=300
+        [binary, "acc"], capture_output=True, text=True, timeout=300
     )
     assert ref.returncode == 0, ref.stderr
 
     ours = subprocess.run(
         [sys.executable, "-m", "pluss_sampler_optimization_tpu", "acc",
-         "--model", "gemm", "--n", "128", "--engine", "oracle"],
+         "--model", "gemm", "--n", "128", "--engine", "oracle",
+         "--threads", str(threads), "--chunk", str(chunk)],
         capture_output=True, text=True, timeout=600, cwd=_REPO,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
@@ -150,6 +169,8 @@ def test_acc_dump_matches_reference(reference_binary):
     for title in ref_sec:
         # Byte-equality line by line: same keys, same counts, same
         # 6-significant-digit fractions, same order.
-        assert our_sec[title] == ref_sec[title], f"section {title!r} differs"
+        assert our_sec[title] == ref_sec[title], (
+            f"t{threads}c{chunk} section {title!r} differs"
+        )
 
     assert _max_iterations(ours.stdout) == _max_iterations(ref.stdout)
