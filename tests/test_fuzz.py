@@ -1,0 +1,118 @@
+"""Generative fuzz: random affine programs through every engine.
+
+The curated model suite pins 18 known kernels; this generates random
+programs *within the documented caps* (README "Model-family limits":
+depth <= 3, positive suffix-product strides so the head dominates,
+rectangular parallel loop, unit-step triangular) and checks, for each:
+
+- numpy oracle vs dense engine: bit-exact PRIState equality;
+- sampled closed-form next-use vs brute-force trace search, for every
+  valid iteration point of every reference (the strongest check).
+
+Seeds are fixed, so failures reproduce; the generator is deliberately
+adversarial about shapes the curated models underuse (post slots,
+zeroed coefficients, nonzero starts, strided rectangular levels, odd
+thread/chunk geometries, zero-trip triangular iterations).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.ir import Loop, ParallelNest, Program, Ref
+from pluss_sampler_optimization_tpu.oracle import run_numpy
+from pluss_sampler_optimization_tpu.sampler import run_dense
+
+from test_sampled import _check_exhaustive_next_use
+
+
+def _random_program(seed: int) -> Program:
+    rng = np.random.default_rng(seed)
+    depth = int(rng.integers(1, 4))
+    tri = depth >= 2 and rng.random() < 0.4
+
+    loops = []
+    for l in range(depth):
+        start = int(rng.integers(0, 3))
+        step = 1 if tri else int(rng.choice([1, 1, 2]))
+        trip = int(rng.integers(2, 8))
+        if tri and l == depth - 1:
+            tc = int(rng.choice([-1, 1]))
+            # descending-bound levels get headroom so not every v0
+            # clamps to zero trips (zero-trip iterations still occur)
+            trip = trip + (loops[0].trip if tc < 0 else 0)
+            loops.append(Loop(trip, start=start, step=1, trip_coeff=tc,
+                              start_coeff=int(rng.choice([0, 1]))))
+        else:
+            loops.append(Loop(trip, start=start, step=step))
+    nest_loops = tuple(loops)
+
+    # per-level value extents bound every reachable loop value; suffix
+    # products make row-major-style strides whose head always dominates
+    # the residual span (the band-candidate cap's requirement)
+    extents = []
+    for l, lp in enumerate(nest_loops):
+        vmax = lp.start + (lp.trip + nest_loops[0].trip *
+                           abs(lp.trip_coeff)) * abs(lp.step)
+        vmax += nest_loops[0].trip * abs(lp.start_coeff)
+        extents.append(vmax + 1)
+
+    refs = []
+    n_refs = int(rng.integers(1, 6))
+    for r in range(n_refs):
+        lv = int(rng.integers(0, depth))
+        coeffs = []
+        for l in range(lv + 1):
+            c = 1
+            for k in range(l + 1, lv + 1):
+                c *= extents[k]
+            coeffs.append(c)
+        # zero a random strict subset (B0-style maps that drop levels)
+        if lv >= 1 and rng.random() < 0.4:
+            z = int(rng.integers(0, lv + 1))
+            coeffs[z] = 0
+            if all(c == 0 for c in coeffs):
+                coeffs[lv] = 1
+        slot = "pre"
+        if lv < depth - 1 and rng.random() < 0.25:
+            slot = "post"
+        thr = int(rng.integers(1, 60)) if rng.random() < 0.3 else None
+        refs.append(Ref(
+            name=f"R{r}", array=rng.choice(["A", "B"]), level=lv,
+            coeffs=tuple(coeffs), const=int(rng.integers(0, 3)),
+            slot=slot, share_threshold=thr,
+        ))
+
+    return Program(name=f"fuzz{seed}", nests=(ParallelNest(
+        loops=nest_loops, refs=tuple(refs)),))
+
+
+def _random_machine(seed: int) -> MachineConfig:
+    rng = np.random.default_rng(seed + 7919)
+    return MachineConfig(
+        thread_num=int(rng.integers(2, 6)),
+        chunk_size=int(rng.integers(1, 6)),
+    )
+
+
+SEEDS = list(range(12))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_dense_matches_oracle(seed):
+    program = _random_program(seed)
+    machine = _random_machine(seed)
+    ref = run_numpy(program, machine)
+    got = run_dense(program, machine)
+    assert got.total_accesses == ref.total_accesses
+    assert got.per_tid_accesses == ref.per_tid_accesses
+    for t in range(machine.thread_num):
+        assert got.state.noshare[t] == ref.state.noshare[t], f"tid {t}"
+        assert got.state.share[t] == ref.state.share[t], f"tid {t}"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_sampled_next_use_exhaustive(seed):
+    _check_exhaustive_next_use(_random_program(seed), _random_machine(seed))

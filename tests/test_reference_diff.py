@@ -141,7 +141,9 @@ def _max_iterations(text: str) -> int:
 
 # default machine, plus odd geometries that stress the chunk/ownership
 # arithmetic (short last chunks, non-divisible thread counts)
-GEOMETRIES = [(4, 4), (3, 5), (8, 2)]
+# 3x5: 128 = 25*5 + 3 (short last chunk), 26 chunks % 3 threads != 0;
+# 7x3: 128 = 42*3 + 2 (short last chunk), 43 chunks % 7 threads != 0
+GEOMETRIES = [(4, 4), (3, 5), (7, 3)]
 
 
 @pytest.mark.parametrize(
