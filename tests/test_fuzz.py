@@ -40,24 +40,33 @@ def _random_program(seed: int) -> Program:
         trip = int(rng.integers(2, 8))
         if tri and l == depth - 1:
             tc = int(rng.choice([-1, 1]))
-            # descending-bound levels get headroom so not every v0
-            # clamps to zero trips (zero-trip iterations still occur)
-            trip = trip + (loops[0].trip if tc < 0 else 0)
+            if tc < 0:
+                # size the base trip INSIDE the parallel value range so
+                # the top v0 values clamp trip_at to zero — the
+                # zero-trip path must actually be exercised
+                lp0 = loops[0]
+                v0_max = lp0.start + (lp0.trip - 1) * lp0.step
+                trip = int(rng.integers(1, max(2, v0_max + 1)))
             loops.append(Loop(trip, start=start, step=1, trip_coeff=tc,
                               start_coeff=int(rng.choice([0, 1]))))
         else:
             loops.append(Loop(trip, start=start, step=step))
     nest_loops = tuple(loops)
 
-    # per-level value extents bound every reachable loop value; suffix
-    # products make row-major-style strides whose head always dominates
-    # the residual span (the band-candidate cap's requirement)
+    # per-level value extents bound every reachable loop value — exact,
+    # by enumerating the (small) parallel range; suffix products of
+    # them make row-major-style strides whose head always dominates the
+    # residual span (the band-candidate cap's requirement)
+    lp0 = nest_loops[0]
+    v0s = [lp0.start + i * lp0.step for i in range(lp0.trip)]
     extents = []
-    for l, lp in enumerate(nest_loops):
-        vmax = lp.start + (lp.trip + nest_loops[0].trip *
-                           abs(lp.trip_coeff)) * abs(lp.step)
-        vmax += nest_loops[0].trip * abs(lp.start_coeff)
-        extents.append(vmax + 1)
+    for lp in nest_loops:
+        vmax = 0
+        for v0 in v0s:
+            tr = lp.trip_at(v0)
+            if tr > 0:
+                vmax = max(vmax, lp.start_at(v0) + (tr - 1) * lp.step)
+        extents.append(max(1, vmax) + 1)
 
     refs = []
     n_refs = int(rng.integers(1, 6))

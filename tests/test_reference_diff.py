@@ -49,13 +49,19 @@ static inline double gsl_ran_negative_binomial_pdf(unsigned int k, double p, dou
 _EMPTY_GUARD = "#ifndef GSL_STUB_{0}_H\n#define GSL_STUB_{0}_H\n#endif\n"
 
 
-def _build_reference(tmp_path_factory, threads: int, chunk: int) -> str:
-    """Build (once, cached) the reference serial oracle sampler.
+def _build_reference(
+    tmp_path_factory, threads: int, chunk: int,
+    variant: str = "ri-omp-seq",
+) -> str:
+    """Build (once, cached) a reference sampler binary.
 
     THREAD_NUM/CHUNK_SIZE are the reference's compile-time -D macros
     (Makefile:14-15), so each machine geometry is its own binary —
     which lets the diff anchor our schedule arithmetic against the
     real reference at odd geometries too, not just the default 4x4.
+    `variant` picks the sampler source: "ri-omp-seq" (the serial
+    accuracy oracle) or "ri-omp" (the PARA binary run.sh's acc
+    protocol pairs with it; its omp pragma pins num_threads(1)).
     """
     if not os.path.isdir(REF):
         pytest.skip("reference checkout not present")
@@ -63,7 +69,7 @@ def _build_reference(tmp_path_factory, threads: int, chunk: int) -> str:
         pytest.skip("no C++ toolchain")
 
     sources = [
-        f"{REF}/sampler/gemm-t4-pluss-pro-model-ri-omp-seq.cpp",
+        f"{REF}/sampler/gemm-t4-pluss-pro-model-{variant}.cpp",
         f"{REF}/runtime/pluss.cpp",
         f"{REF}/runtime/pluss_utils.cpp",
     ]
@@ -86,7 +92,7 @@ def _build_reference(tmp_path_factory, threads: int, chunk: int) -> str:
             h.update(f.read())
     cached = os.path.join(
         _REPO, ".refbuild",
-        f"ri-omp-seq-t{threads}c{chunk}-{h.hexdigest()[:12]}",
+        f"{variant}-t{threads}c{chunk}-{h.hexdigest()[:12]}",
     )
     if os.path.exists(cached):
         return cached
@@ -122,7 +128,9 @@ def _sections(text: str) -> dict[str, list[str]]:
         line = line.strip()
         if line in titles:
             current = out.setdefault(line, [])
-        elif line.startswith(("max iteration", "SEQ C++", "PARA C++")) or not line:
+        elif line.startswith(
+            ("max iteration", "SEQ C++", "PARA C++", "OPENMP C++")
+        ) or not line:
             current = None
         elif current is not None:
             current.append(line)
@@ -176,3 +184,44 @@ def test_acc_dump_matches_reference(tmp_path_factory, threads, chunk):
         )
 
     assert _max_iterations(ours.stdout) == _max_iterations(ref.stdout)
+
+
+def test_acc_protocol_para_and_seq(tmp_path_factory):
+    """The reference acc protocol runs the PARA binary then the SEQ
+    binary and diffs (run.sh acc, Makefile:39-41). Reproduce it: both
+    binaries' histogram sections must agree with each other and with
+    our oracle dump (PARA emits no MRC section, so the comparison
+    covers the three histogram dumps and the iteration count)."""
+    seq = _build_reference(tmp_path_factory, 4, 4, "ri-omp-seq")
+    para = _build_reference(tmp_path_factory, 4, 4, "ri-omp")
+    out = {}
+    for name, binary in (("seq", seq), ("para", para)):
+        proc = subprocess.run(
+            [binary, "acc"], capture_output=True, text=True, timeout=300
+        )
+        assert proc.returncode == 0, proc.stderr
+        out[name] = proc
+    ours = subprocess.run(
+        [sys.executable, "-m", "pluss_sampler_optimization_tpu", "acc",
+         "--model", "gemm", "--n", "128", "--engine", "oracle"],
+        capture_output=True, text=True, timeout=600, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert ours.returncode == 0, ours.stderr
+
+    seq_sec = _sections(out["seq"].stdout)
+    para_sec = _sections(out["para"].stdout)
+    our_sec = _sections(ours.stdout)
+    # a parse/title drift must fail loudly, not compare zero sections
+    assert set(para_sec) == {
+        "Start to dump noshare private reuse time",
+        "Start to dump share private reuse time",
+        "Start to dump reuse time",
+    }
+    for title, lines in para_sec.items():
+        assert lines == seq_sec[title], f"PARA vs SEQ: {title!r}"
+        assert lines == our_sec[title], f"PARA vs ours: {title!r}"
+    for name in ("seq", "para"):
+        assert _max_iterations(out[name].stdout) == _max_iterations(
+            ours.stdout
+        )
