@@ -56,6 +56,10 @@ def _run_engine(engine: str, program, machine, args):
         from . import native
 
         return native.run_serial_native(program, machine), None
+    if engine == "native-par":
+        from . import native
+
+        return native.run_parallel_native(program, machine), None
     if engine == "dense":
         from .sampler.dense import run_dense
 
@@ -110,8 +114,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--engine",
         default=None,
-        help="oracle | numpy | native | dense | stream | sampled | "
-        "sharded (default: dense; sample mode forces sampled)",
+        help="oracle | numpy | native | native-par | dense | stream | "
+        "sampled | sharded (default: dense; sample mode forces sampled)",
     )
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--chunk", type=int, default=4)

@@ -62,7 +62,7 @@ def _load() -> ctypes.CDLL:
     except (OSError, subprocess.CalledProcessError) as e:
         _build_error = f"native runtime unavailable: {e}"
         raise RuntimeError(_build_error) from e
-    lib.pluss_run_serial.restype = ctypes.c_int64
+    lib.pluss_run.restype = ctypes.c_int64
     _lib = lib
     return lib
 
@@ -87,6 +87,22 @@ def run_serial_native(
     program: Program, machine: MachineConfig, share_cap: int = 1 << 16
 ) -> OracleResult:
     """Native serial walk -> OracleResult, bit-exact vs oracle.run_serial."""
+    return _run_native(program, machine, share_cap, parallel=False)
+
+
+def run_parallel_native(
+    program: Program, machine: MachineConfig, share_cap: int = 1 << 16
+) -> OracleResult:
+    """Native parallel walk: one OS thread per simulated thread (the
+    reference `ri` variant's omp-over-tids execution model,
+    ...ri.cpp:67), thread-local histograms merged at join. Bit-identical
+    output to run_serial_native."""
+    return _run_native(program, machine, share_cap, parallel=True)
+
+
+def _run_native(
+    program: Program, machine: MachineConfig, share_cap: int, parallel: bool
+) -> OracleResult:
     lib = _load()
     n_nests = len(program.nests)
     tables = [
@@ -120,7 +136,8 @@ def run_serial_native(
     share_count = np.zeros(1, dtype=np.int64)
     per_tid = np.zeros(P, dtype=np.int64)
 
-    rc = lib.pluss_run_serial(
+    rc = lib.pluss_run(
+        ctypes.c_int64(1 if parallel else 0),
         ctypes.c_int64(P),
         ctypes.c_int64(machine.chunk_size),
         ctypes.c_int64(machine.ds),
@@ -134,6 +151,11 @@ def run_serial_native(
         _ptr(noshare_bins), _ptr(share_out), _ptr(share_count),
         ctypes.c_int64(share_cap), _ptr(per_tid),
     )
+    if rc == 2:
+        raise RuntimeError(
+            "native parallel execution failed (thread spawn or worker "
+            "exception)"
+        )
     if rc != 0:
         raise RuntimeError(
             f"native share capacity exceeded: need {int(share_count[0])}, "

@@ -1,17 +1,24 @@
-// Native serial sampler runtime.
+// Native sampler runtime (serial + thread-parallel).
 //
-// C++ twin of the reference's serial generated sampler + runtime-v1
+// C++ twin of the reference's generated samplers + runtime-v1
 // histogram layer (c_lib/test/sampler/gemm-t4-pluss-pro-model-ri-omp-seq.cpp,
 // c_lib/test/runtime/pluss_utils.h), generalized over the loop-nest IR
 // (pluss_sampler_optimization_tpu/ir.py) instead of generated per
-// benchmark. It plays two roles:
+// benchmark. It plays three roles:
 //
 // 1. fast oracle: bit-exact against the Python serial oracle
 //    (oracle/serial.py) at any size, hundreds of times faster — large-N
 //    parity tests for the TPU engines anchor on it;
 // 2. speed baseline: its single-core walk is the reference protocol's
 //    "serial C++ sampler" (BASELINE.md) that bench.py compares the TPU
-//    engines against.
+//    engines against;
+// 3. parallel native engine: pluss_run_parallel runs one std::thread
+//    per *simulated* thread — the execution model of the reference's
+//    `ri` variant (#pragma omp parallel for over tids, ...ri.cpp:67)
+//    done with the thread-local-histogram + merge-at-join reduction
+//    that is the reference's only genuinely race-free design
+//    (src/unsafe_utils.rs:32-35,105-151). Every piece of sampler state
+//    is tid-owned, so the output is bit-identical to the serial walk.
 //
 // The walk mirrors the reference exactly: per simulated thread, chunks
 // in static dispatch order (pluss_utils.h:410-425), the body reference
@@ -25,8 +32,10 @@
 
 #include <algorithm>
 #include <array>
+#include <atomic>
 #include <cstdint>
 #include <map>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -64,8 +73,11 @@ struct State {
   std::vector<std::unordered_map<int64_t, int64_t>> lat;
   // noshare_bins[tid * kNoShareSlots + bin]
   int64_t* noshare_bins;
-  // share[(tid, ratio, raw reuse)] -> count
-  std::map<std::array<int64_t, 3>, int64_t> share;
+  // per-tid share[(ratio, raw reuse)] -> count. Keeping the maps
+  // tid-local makes the parallel walk race-free by construction (the
+  // TLS + merge-at-join reduction); the serial walk uses the same
+  // layout so both paths emit identically ordered output.
+  std::vector<std::map<std::array<int64_t, 2>, int64_t>> share;
 };
 
 inline int pow2_bin(int64_t reuse) {
@@ -74,15 +86,19 @@ inline int pow2_bin(int64_t reuse) {
   return 63 - __builtin_clzll(static_cast<uint64_t>(reuse));
 }
 
+// `clock` is the thread's access counter, kept in a walk-local instead
+// of s.count[tid]: the per-tid counters share cache lines, and the
+// clock increments on EVERY simulated access — through the vector it
+// would ping-pong between cores and erase the parallel walk's scaling.
 inline void access(State& s, int64_t tid, const Ref& r,
-                   const int64_t* ivs) {
+                   const int64_t* ivs, int64_t& clock) {
   int64_t flat = r.cnst;
   for (int64_t l = 0; l <= r.level; ++l) flat += r.coeffs[l] * ivs[l];
   const int64_t addr = flat * s.ds / s.cls;
   auto& table = s.lat[tid * s.n_arrays + r.array];
   auto it = table.find(addr);
   if (it != table.end()) {
-    const int64_t reuse = s.count[tid] - it->second;
+    const int64_t reuse = clock - it->second;
     bool is_share = false;
     if (r.share_threshold >= 0) {
       // distance_to(reuse, 0) > distance_to(reuse, threshold)
@@ -93,20 +109,20 @@ inline void access(State& s, int64_t tid, const Ref& r,
       is_share = d0 > dt;
     }
     if (is_share) {
-      s.share[{tid, r.share_ratio, reuse}] += 1;
+      s.share[tid][{r.share_ratio, reuse}] += 1;
     } else {
       s.noshare_bins[tid * kNoShareSlots + pow2_bin(reuse)] += 1;
     }
-    it->second = s.count[tid];
+    it->second = clock;
   } else {
-    table.emplace(addr, s.count[tid]);
+    table.emplace(addr, clock);
   }
-  s.count[tid] += 1;
+  clock += 1;
 }
 
 void body(State& s, const Nest& nest, int64_t tid, int64_t level,
-          int64_t* ivs) {
-  for (const Ref& r : nest.pre[level]) access(s, tid, r, ivs);
+          int64_t* ivs, int64_t& clock) {
+  for (const Ref& r : nest.pre[level]) access(s, tid, r, ivs, clock);
   if (level + 1 < nest.depth) {
     // triangular levels: bounds affine in the parallel value ivs[0]
     const int64_t trip =
@@ -117,19 +133,33 @@ void body(State& s, const Nest& nest, int64_t tid, int64_t level,
     const int64_t step = nest.steps[level + 1];
     for (int64_t n = 0; n < trip; ++n) {
       ivs[level + 1] = start + n * step;
-      body(s, nest, tid, level + 1, ivs);
+      body(s, nest, tid, level + 1, ivs, clock);
     }
   }
-  for (const Ref& r : nest.post[level]) access(s, tid, r, ivs);
+  for (const Ref& r : nest.post[level]) access(s, tid, r, ivs, clock);
 }
 
-}  // namespace
+// One simulated thread's full chunk walk over one nest
+// (getNextStaticChunk order, pluss_utils.h:410-425). Touches only
+// tid-owned state, so it is safe to run tids concurrently.
+void walk_tid(State& s, const Nest& nest, int64_t tid) {
+  const int64_t trip0 = nest.trips[0];
+  const int64_t n_chunks = (trip0 + s.chunk_size - 1) / s.chunk_size;
+  int64_t clock = s.count[tid];  // clocks run across nests
+  for (int64_t cid = tid; cid < n_chunks; cid += s.thread_num) {
+    const int64_t lo = cid * s.chunk_size;
+    const int64_t hi = std::min(lo + s.chunk_size, trip0);
+    for (int64_t n = lo; n < hi; ++n) {
+      int64_t ivs[kMaxDepth];
+      ivs[0] = nest.starts[0] + n * nest.steps[0];
+      body(s, nest, tid, 0, ivs, clock);
+    }
+  }
+  s.count[tid] = clock;
+}
 
-extern "C" {
-
-// Returns 0 on success, 1 when share quadruples exceed share_cap (the
-// required count is still written to share_count_out).
-int64_t pluss_run_serial(
+int64_t run_impl(
+    bool parallel,
     int64_t thread_num, int64_t chunk_size, int64_t ds, int64_t cls,
     int64_t n_nests, const int64_t* depths, const int64_t* trips,
     const int64_t* starts, const int64_t* steps,
@@ -138,9 +168,7 @@ int64_t pluss_run_serial(
     const int64_t* ref_coeffs, const int64_t* ref_consts,
     const int64_t* ref_arrays, const int64_t* ref_slots,
     const int64_t* ref_share_thresholds, const int64_t* ref_share_ratios,
-    int64_t n_arrays,
-    int64_t* noshare_bins,  // (thread_num * kNoShareSlots), zeroed here
-    int64_t* share_out,     // (share_cap * 4): tid, ratio, value, count
+    int64_t n_arrays, int64_t* noshare_bins, int64_t* share_out,
     int64_t* share_count_out, int64_t share_cap,
     int64_t* per_tid_accesses) {
   State s;
@@ -151,6 +179,7 @@ int64_t pluss_run_serial(
   s.n_arrays = n_arrays;
   s.count.assign(thread_num, 0);
   s.lat.resize(thread_num * n_arrays);
+  s.share.resize(thread_num);
   s.noshare_bins = noshare_bins;
   for (int64_t i = 0; i < thread_num * kNoShareSlots; ++i)
     noshare_bins[i] = 0;
@@ -181,20 +210,33 @@ int64_t pluss_run_serial(
   }
 
   for (const Nest& nest : nests) {
-    const int64_t trip0 = nest.trips[0];
-    const int64_t n_chunks = (trip0 + chunk_size - 1) / chunk_size;
-    for (int64_t tid = 0; tid < thread_num; ++tid) {
-      // chunks of this thread in static dispatch order
-      // (getNextStaticChunk, pluss_utils.h:410-425)
-      for (int64_t cid = tid; cid < n_chunks; cid += thread_num) {
-        const int64_t lo = cid * chunk_size;
-        const int64_t hi = std::min(lo + chunk_size, trip0);
-        for (int64_t n = lo; n < hi; ++n) {
-          int64_t ivs[kMaxDepth];
-          ivs[0] = nest.starts[0] + n * nest.steps[0];
-          body(s, nest, tid, 0, ivs);
-        }
+    if (parallel) {
+      // one OS thread per simulated thread, barrier per nest (the
+      // implicit barrier of the reference's per-nest omp region).
+      // Exceptions must not cross the extern "C" boundary or escape a
+      // worker (either aborts the host interpreter): contain them and
+      // surface rc 2.
+      std::atomic<int> err{0};
+      std::vector<std::thread> workers;
+      workers.reserve(thread_num);
+      try {
+        for (int64_t tid = 0; tid < thread_num; ++tid)
+          workers.emplace_back([&s, &nest, &err, tid] {
+            try {
+              walk_tid(s, nest, tid);
+            } catch (...) {
+              err.store(1);
+            }
+          });
+      } catch (...) {  // thread spawn failed (resource exhaustion)
+        err.store(1);
       }
+      for (auto& w : workers)
+        if (w.joinable()) w.join();
+      if (err.load() != 0) return 2;
+    } else {
+      for (int64_t tid = 0; tid < thread_num; ++tid)
+        walk_tid(s, nest, tid);
     }
     // per-nest -1 flush + LAT clear (...ri-omp-seq.cpp:303-319)
     for (int64_t tid = 0; tid < thread_num; ++tid) {
@@ -209,18 +251,58 @@ int64_t pluss_run_serial(
     }
   }
 
-  *share_count_out = static_cast<int64_t>(s.share.size());
+  int64_t total = 0;
+  for (int64_t t = 0; t < thread_num; ++t)
+    total += static_cast<int64_t>(s.share[t].size());
+  *share_count_out = total;
   int64_t written = 0;
-  for (const auto& kv : s.share) {
-    if (written >= share_cap) break;
-    share_out[written * 4 + 0] = kv.first[0];
-    share_out[written * 4 + 1] = kv.first[1];
-    share_out[written * 4 + 2] = kv.first[2];
-    share_out[written * 4 + 3] = kv.second;
-    ++written;
+  // tid-major emit over per-tid sorted maps == the old global
+  // {tid, ratio, reuse}-sorted map order
+  for (int64_t t = 0; t < thread_num && written < share_cap; ++t) {
+    for (const auto& kv : s.share[t]) {
+      if (written >= share_cap) break;
+      share_out[written * 4 + 0] = t;
+      share_out[written * 4 + 1] = kv.first[0];
+      share_out[written * 4 + 2] = kv.first[1];
+      share_out[written * 4 + 3] = kv.second;
+      ++written;
+    }
   }
   for (int64_t t = 0; t < thread_num; ++t) per_tid_accesses[t] = s.count[t];
-  return static_cast<int64_t>(s.share.size()) > share_cap ? 1 : 0;
+  return total > share_cap ? 1 : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// parallel != 0 runs one std::thread per simulated thread (the
+// reference `ri` variant's execution model) with bit-identical output
+// to the serial walk. Returns 0 on success, 1 when share quadruples
+// exceed share_cap (the required count is still written to
+// share_count_out), 2 when parallel execution failed (thread spawn or
+// a worker exception).
+int64_t pluss_run(
+    int64_t parallel,
+    int64_t thread_num, int64_t chunk_size, int64_t ds, int64_t cls,
+    int64_t n_nests, const int64_t* depths, const int64_t* trips,
+    const int64_t* starts, const int64_t* steps,
+    const int64_t* trip_coeffs, const int64_t* start_coeffs,
+    const int64_t* nest_ref_off, const int64_t* ref_levels,
+    const int64_t* ref_coeffs, const int64_t* ref_consts,
+    const int64_t* ref_arrays, const int64_t* ref_slots,
+    const int64_t* ref_share_thresholds, const int64_t* ref_share_ratios,
+    int64_t n_arrays,
+    int64_t* noshare_bins,  // (thread_num * kNoShareSlots), zeroed here
+    int64_t* share_out,     // (share_cap * 4): tid, ratio, value, count
+    int64_t* share_count_out, int64_t share_cap,
+    int64_t* per_tid_accesses) {
+  return run_impl(
+      parallel != 0, thread_num, chunk_size, ds, cls, n_nests, depths,
+      trips, starts, steps, trip_coeffs, start_coeffs, nest_ref_off,
+      ref_levels, ref_coeffs, ref_consts, ref_arrays, ref_slots,
+      ref_share_thresholds, ref_share_ratios, n_arrays, noshare_bins,
+      share_out, share_count_out, share_cap, per_tid_accesses);
 }
 
 }  // extern "C"

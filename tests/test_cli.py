@@ -22,7 +22,7 @@ def test_acc_dumps_identical_across_engines(capsys):
         from pluss_sampler_optimization_tpu import native
 
         if native.available():
-            engines.append("native")
+            engines += ["native", "native-par"]
     except Exception:
         pass
     for engine in engines:

@@ -56,6 +56,41 @@ def test_native_share_capacity_error():
         native.run_serial_native(gemm(24), MACHINE, share_cap=1)
 
 
+@pytest.mark.parametrize(
+    "prog",
+    [gemm(16), gemm(17), mm2(12), jacobi2d(10, tsteps=2), bicg(13, 17)],
+    ids=lambda p: p.name,
+)
+def test_native_parallel_matches_serial(prog):
+    """One OS thread per simulated thread, thread-local histograms
+    merged at join: the output must be bit-identical to the serial
+    native walk (every piece of sampler state is tid-owned)."""
+    _results_equal(
+        native.run_serial_native(prog, MACHINE),
+        native.run_parallel_native(prog, MACHINE),
+    )
+
+
+def test_native_parallel_odd_machines():
+    for m in (MachineConfig(thread_num=3, chunk_size=5),
+              MachineConfig(thread_num=7, chunk_size=2)):
+        for prog in (gemm(14), mm2(10)):
+            _results_equal(
+                native.run_serial_native(prog, m),
+                native.run_parallel_native(prog, m),
+            )
+
+
+def test_native_parallel_triangular():
+    from pluss_sampler_optimization_tpu.models import syrk_tri, trmm
+
+    for prog in (syrk_tri(9), trmm(8, 11)):
+        _results_equal(
+            native.run_serial_native(prog, MACHINE),
+            native.run_parallel_native(prog, MACHINE),
+        )
+
+
 def test_native_triangular_models():
     from pluss_sampler_optimization_tpu.models import (
         covariance,
