@@ -12,7 +12,7 @@
 // 2. speed baseline: its single-core walk is the reference protocol's
 //    "serial C++ sampler" (BASELINE.md) that bench.py compares the TPU
 //    engines against;
-// 3. parallel native engine: pluss_run_parallel runs one std::thread
+// 3. parallel native engine: pluss_run(parallel=1) runs one std::thread
 //    per *simulated* thread — the execution model of the reference's
 //    `ri` variant (#pragma omp parallel for over tids, ...ri.cpp:67)
 //    done with the thread-local-histogram + merge-at-join reduction
