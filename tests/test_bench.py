@@ -11,6 +11,27 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402  (repo-root module)
 
 
+def test_probe_budget_contract():
+    """The probe must never block past --device-timeout: attempt
+    schedule plus the optional relay TCP scan stay within the budget
+    (the scan is skipped entirely when the budget cannot absorb it)."""
+    import time
+
+    if os.path.exists(bench._PROBE_MARKER):
+        os.remove(bench._PROBE_MARKER)
+    t0 = time.perf_counter()
+    ok, evidence = bench.probe_accelerator(8.0)
+    wall = time.perf_counter() - t0
+    assert wall <= 8.0 + 3.0  # subprocess spawn slack
+    attempts = [e for e in evidence if "attempt" in e]
+    assert sum(e["seconds"] for e in attempts) <= 8.0 + 1.0
+    # budget <= 10s: the relay scan must have been skipped
+    assert not any("relay_tcp" in e for e in evidence)
+    if ok:  # healthy accelerator: nothing more to assert
+        return
+    assert attempts and attempts[0]["rc"] in ("timeout", 1)
+
+
 def test_bench_emits_json_line():
     # a cached successful probe would bypass --device-timeout and let
     # the subprocess block on a stalled accelerator tunnel
