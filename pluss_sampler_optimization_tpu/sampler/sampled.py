@@ -603,6 +603,56 @@ def sampled_outputs(
     return results
 
 
+def results_from_samples(
+    program: Program,
+    machine: MachineConfig,
+    samples_by_ref: dict,
+) -> list[SampledRefResult]:
+    """Explicit-sample surface: classify caller-provided sample tuples.
+
+    `samples_by_ref` maps tracked reference name -> (S, depth) array of
+    normalized iteration tuples; each provided ref is classified with
+    the same closed-form kernels sampled_outputs uses and folded into a
+    SampledRefResult. Refs not present in the mapping are skipped.
+
+    This exists for external anchoring: the suite determinizes the
+    reference r10 binary's RNG, replicates its draw loop in Python, and
+    hands the *identical* sample sets to both sides, so the comparison
+    isolates the reuse/distribute model from sampling noise
+    (tests/test_reference_diff.py). Requires tracked ref names to be
+    unique across nests (true for every registered model).
+    """
+    trace, kernels = _program_kernels(program, machine)
+    seen: set[str] = set()
+    results = []
+    for k, ri, _ in kernels:
+        nt = trace.nests[k]
+        name = nt.tables.ref_names[ri]
+        if name not in samples_by_ref:
+            continue
+        if name in seen:
+            raise ValueError(
+                f"tracked ref name {name!r} is not unique across nests; "
+                "explicit sample routing would be ambiguous"
+            )
+        seen.add(name)
+        samples = jnp.asarray(np.asarray(samples_by_ref[name], np.int64))
+        packed, _, _, found = classify_samples(nt, ri, samples)
+        packed, found = np.asarray(packed), np.asarray(found)
+        keys, counts = np.unique(packed[found], return_counts=True)
+        noshare: dict[int, float] = {}
+        share: dict[int, dict[int, float]] = {}
+        decode_pairs(keys, counts, noshare, share)
+        results.append(SampledRefResult(
+            name=name, noshare=noshare, share=share,
+            cold=float((~found).sum()), n_samples=len(samples),
+        ))
+    missing = set(samples_by_ref) - seen
+    if missing:
+        raise ValueError(f"unknown tracked refs: {sorted(missing)}")
+    return results
+
+
 def fold_results(
     results: list[SampledRefResult], thread_num: int, v2: bool = False
 ) -> PRIState:

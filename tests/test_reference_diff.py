@@ -49,6 +49,38 @@ static inline double gsl_ran_negative_binomial_pdf(unsigned int k, double p, dou
 _EMPTY_GUARD = "#ifndef GSL_STUB_{0}_H\n#define GSL_STUB_{0}_H\n#endif\n"
 
 
+# Deterministic replacement for the libc rand() stream, injected into
+# the r10 build via -include. The r10 sampler never calls srand (its
+# mt19937 generators are initialized from time(NULL) but unused), so
+# its rand() draws come from glibc's shared, lock-serialized default
+# stream — deterministic per-thread partitioning is impossible because
+# six sampler threads race for the next value (:3203-3251). A
+# thread_local LCG seeded with a fixed constant gives every sampler
+# thread its own identical, reproducible stream; the Python test
+# replicates the same LCG to hand our engine the exact sample sets the
+# binary drew.
+_RAND_SHIM = """\
+#ifndef PLUSS_TEST_RAND_SHIM_H
+#define PLUSS_TEST_RAND_SHIM_H
+#include <cstdlib>
+inline thread_local unsigned long long _pluss_det_rand_state =
+    0x243F6A8885A308D3ULL;
+inline int _pluss_det_rand(void)
+{
+    _pluss_det_rand_state =
+        _pluss_det_rand_state * 6364136223846793005ULL
+        + 1442695040888963407ULL;
+    return (int)((_pluss_det_rand_state >> 33) & 0x7fffffffULL);
+}
+/* libstdc++ spells std::rand in <bits/stl_algo.h>; the using-decl
+   makes the macro expansion valid in both qualified and unqualified
+   forms. */
+namespace std { using ::_pluss_det_rand; }
+#define rand _pluss_det_rand
+#endif
+"""
+
+
 def _build_reference(
     tmp_path_factory, threads: int, chunk: int,
     variant: str = "ri-omp-seq",
@@ -60,8 +92,11 @@ def _build_reference(
     which lets the diff anchor our schedule arithmetic against the
     real reference at odd geometries too, not just the default 4x4.
     `variant` picks the sampler source: "ri-omp-seq" (the serial
-    accuracy oracle) or "ri-omp" (the PARA binary run.sh's acc
-    protocol pairs with it; its omp pragma pins num_threads(1)).
+    accuracy oracle), "ri-omp" (the PARA binary run.sh's acc protocol
+    pairs with it; its omp pragma pins num_threads(1)), or
+    "rs-ri-opt-r10" (the random-start sampled binary, built with the
+    deterministic rand shim above and -pthread for its six sampler
+    threads).
     """
     if not os.path.isdir(REF):
         pytest.skip("reference checkout not present")
@@ -73,12 +108,14 @@ def _build_reference(
         f"{REF}/runtime/pluss.cpp",
         f"{REF}/runtime/pluss_utils.cpp",
     ]
+    shim = _RAND_SHIM if variant == "rs-ri-opt-r10" else ""
     # Flags from the reference Makefile:20-21, minus GSL/LTO (stubbed /
     # irrelevant for a correctness diff). {build} is substituted below.
     cmd_tail = [
         "-std=c++17", "-O2", "-fopenmp", f"-I{REF}/runtime",
         f"-DTHREAD_NUM={threads}", f"-DCHUNK_SIZE={chunk}",
         "-DDS=8", "-DCLS=64",
+        *(["-pthread"] if shim else []),
         *sources, "-lm",
     ]
     # Cache key covers the stub, the compile line, and the reference
@@ -86,6 +123,7 @@ def _build_reference(
     # silently diffing against a stale oracle binary.
     h = hashlib.sha256()
     h.update(_GSL_RANDIST_STUB.encode())
+    h.update(shim.encode())
     h.update(" ".join(cmd_tail).encode())
     for src in sources + [f"{REF}/runtime/pluss.h", f"{REF}/runtime/pluss_utils.h"]:
         with open(src, "rb") as f:
@@ -105,7 +143,11 @@ def _build_reference(
     (gsl / "gsl_cdf.h").write_text(_EMPTY_GUARD.format("CDF"))
 
     out = build / "ri-omp-seq"
-    cmd = ["g++", f"-I{build}", *cmd_tail, "-o", str(out)]
+    pre = []
+    if shim:
+        (build / "rand_shim.h").write_text(shim)
+        pre = ["-include", str(build / "rand_shim.h")]
+    cmd = ["g++", f"-I{build}", *pre, *cmd_tail, "-o", str(out)]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, f"reference build failed:\n{proc.stderr}"
 
@@ -184,6 +226,190 @@ def test_acc_dump_matches_reference(tmp_path_factory, threads, chunk):
         )
 
     assert _max_iterations(ours.stdout) == _max_iterations(ref.stdout)
+
+
+class _DetRand:
+    """Python twin of the _RAND_SHIM LCG (same constants, same output
+    derivation), used to replicate the binary's sample draws."""
+
+    MUL = 6364136223846793005
+    INC = 1442695040888963407
+
+    def __init__(self):
+        self.s = 0x243F6A8885A308D3
+
+    def __call__(self) -> int:
+        self.s = (self.s * self.MUL + self.INC) & 0xFFFFFFFFFFFFFFFF
+        return (self.s >> 33) & 0x7FFFFFFF
+
+
+def _draw_like_r10(depth: int, num_samples: int, mod: int):
+    """Replicate one r10 sampler thread's draw loop: per attempt, one
+    rand()%mod per loop level (:159-169 — mod = trip-1 excludes the last
+    iteration), label-dedup'd until num_samples unique tuples (:177).
+    Every sampler thread starts from the same thread_local shim state,
+    so every same-depth ref draws this exact set."""
+    import numpy as np
+
+    rng = _DetRand()
+    seen: set = set()
+    out: list = []
+    while len(out) < num_samples:
+        t = tuple(rng() % mod for _ in range(depth))
+        if t in seen:
+            continue
+        seen.add(t)
+        out.append(t)
+    return np.asarray(out, dtype=np.int64)
+
+
+def _parse_r10_dump(text: str):
+    """The r10 binary's stdout -> ({section title: {key: count}},
+    run-length MRC points). Sections: six per-ref histograms titled by
+    bare ref name (_pluss_histogram_print("C3", ...), :3281-3286), the
+    merged "Start to dump reuse time" (:3287), and "miss ratio"
+    (:3288); the timer line is a bare float and parses as neither."""
+    hists: dict[str, dict] = {}
+    mrc_pts: list = []
+    titles = {"C3", "C2", "A0", "C0", "B0", "C1",
+              "Start to dump reuse time"}
+    current: dict | None = None
+    in_mrc = False
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line in titles:
+            current, in_mrc = hists.setdefault(line, {}), False
+            continue
+        if line == "miss ratio":
+            current, in_mrc = None, True
+            continue
+        if line == "max iteration traversed":
+            current, in_mrc = None, False
+            continue
+        parts = line.split(",")
+        if in_mrc and len(parts) == 2:
+            mrc_pts.append((int(parts[0]), float(parts[1])))
+        elif current is not None and len(parts) == 3:
+            current[int(parts[0])] = float(parts[1])
+    return hists, mrc_pts
+
+
+def _dense_mrc(points):
+    """Run-length MRC points -> dense array (piecewise-constant fill;
+    within a printed segment the true values deviate < 1e-5 from the
+    segment head, pluss_utils.h:863)."""
+    import numpy as np
+
+    n = points[-1][0] + 1
+    out = np.empty(n, dtype=np.float64)
+    for (i, v), (j, _) in zip(points, points[1:] + [(n, 0.0)]):
+        out[i:j] = v
+    return out
+
+
+def _rel_l1(a: dict, b: dict, normalize: bool = False) -> float:
+    """sum |a-b| / sum a over the union support; `normalize` first
+    scales both to unit mass (shape-only comparison)."""
+    sa, sb = sum(a.values()), sum(b.values())
+    fa, fb = (1.0 / sa, 1.0 / sb) if normalize else (1.0, 1.0)
+    diff = sum(
+        abs(a.get(k, 0.0) * fa - b.get(k, 0.0) * fb)
+        for k in set(a) | set(b)
+    )
+    return diff / (1.0 if normalize else sa)
+
+
+def test_r10_sampled_matches_reference(tmp_path_factory):
+    """External anchor for the sampled path: run the ACTUAL r10 binary
+    (deterministic rand shim) and diff its per-ref histograms, merged
+    RIHist, and MRC against our explicit-sample engine fed the
+    IDENTICAL sample sets (replicated draw loop), distributed with the
+    R10Quirks model (runtime/cri.py).
+
+    The comparison is shape-normalized, not byte-exact, for two
+    walk-scheduling artifacts our sample-independent engine does not
+    (and should not) reproduce:
+
+    - the out-of-order check `samples_meet.size() >= samples.size()`
+      (:356,:417,:499 and per-sampler copies) terminates the WHOLE
+      sampler once the historically-met count reaches the remaining
+      queue size — samples_meet is never pruned, so late in the run
+      this drops still-unprocessed samples (measured: ~5.4% of A0's
+      2098, ~1.9% of C0/C1's 164, ~0.3% of C3/C2);
+    - a later sample's walk rewinds other simulated threads' cursors
+      and can re-register an already-processed sample (sample_names is
+      never pruned, :549-556), double-counting its reuse.
+
+    Both scale every bin of a ref's histogram uniformly, so comparing
+    unit-normalized histograms (plus a mass-ratio guard bounding the
+    artifact) still pins the whole quirk model — exponent n-1, pow2
+    point mass, 0.999 stop, degenerate share NBD, per-ref local
+    distributes, B0 threshold 65792 — against the real binary: a
+    misread quirk shifts histogram regions, not overall mass, and
+    fails loudly."""
+    binary = _build_reference(tmp_path_factory, 4, 4, "rs-ri-opt-r10")
+    ref = subprocess.run(
+        [binary], capture_output=True, text=True, timeout=600
+    )
+    assert ref.returncode == 0, ref.stderr
+    ref_hists, ref_mrc_pts = _parse_r10_dump(ref.stdout)
+    assert set(ref_hists) == {
+        "C3", "C2", "A0", "C0", "B0", "C1", "Start to dump reuse time"
+    }
+
+    # Our side: identical sample sets through the closed-form engine +
+    # r10 quirk distributes. Sample counts are the generated constants
+    # (2098 for 3-deep refs :156, 164 for 2-deep :1688) at N=128,
+    # mod 127 (the rand()%(trip-1) draw, :159).
+    from pluss_sampler_optimization_tpu import MachineConfig
+    from pluss_sampler_optimization_tpu.models import REGISTRY
+    from pluss_sampler_optimization_tpu.runtime.aet import (
+        aet_mrc,
+        mrc_l1_error,
+    )
+    from pluss_sampler_optimization_tpu.runtime.cri import r10_distribute
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        results_from_samples,
+    )
+
+    s3 = _draw_like_r10(3, 2098, 127)
+    s2 = _draw_like_r10(2, 164, 127)
+    machine = MachineConfig()
+    results = results_from_samples(
+        REGISTRY["gemm"](128), machine,
+        {"C3": s3, "C2": s3, "A0": s3, "B0": s3, "C0": s2, "C1": s2},
+    )
+    assert {r.name: r.n_samples for r in results} == {
+        "C3": 2098, "C2": 2098, "A0": 2098, "B0": 2098,
+        "C0": 164, "C1": 164,
+    }
+    merged, per_ref = r10_distribute(results, machine.thread_num)
+
+    for name in ("C3", "C2", "A0", "C0", "B0", "C1"):
+        # bin support must agree exactly on every bin carrying >=1% of
+        # the ref's mass (walk double-counting can add trace-mass bins)
+        tot = sum(ref_hists[name].values())
+        major_ref = {k for k, v in ref_hists[name].items() if v >= tot / 100}
+        major_ours = {
+            k for k, v in per_ref[name].items()
+            if v >= sum(per_ref[name].values()) / 100
+        }
+        assert major_ref == major_ours, f"{name} major-bin support"
+        assert _rel_l1(
+            ref_hists[name], per_ref[name], normalize=True
+        ) < 0.02, name
+        # mass-ratio guard: the binary's early-exit drop is bounded
+        # (<=6% observed on A0); a model error would not show up as a
+        # uniform deficit on the reference side only
+        ratio = tot / sum(per_ref[name].values())
+        assert 0.90 < ratio < 1.005, f"{name} mass ratio {ratio}"
+
+    assert _rel_l1(
+        ref_hists["Start to dump reuse time"], merged, normalize=True
+    ) < 0.02
+    ours_mrc = aet_mrc(merged, machine)
+    ref_mrc = _dense_mrc(ref_mrc_pts)
+    assert mrc_l1_error(ours_mrc, ref_mrc) < 1e-2
 
 
 def test_acc_protocol_para_and_seq(tmp_path_factory):
