@@ -48,6 +48,26 @@ static inline double gsl_ran_negative_binomial_pdf(unsigned int k, double p, dou
 
 _EMPTY_GUARD = "#ifndef GSL_STUB_{0}_H\n#define GSL_STUB_{0}_H\n#endif\n"
 
+# The reference's parallel-hashmap submodule (.gitmodules:1-3) is not
+# initialized in this checkout (the directory is empty), so runtime v2
+# builds against this stub instead. phmap::flat_hash_map is drop-in
+# API-compatible with std::unordered_map for everything the runtime
+# instantiates (Histogram = flat_hash_map<long,double>, _SharePRI's
+# flat_hash_map<int,Histogram> — pluss_utils_v2.h:18,24), and every
+# print path sorts through an ordered std::map first
+# (pluss_utils_v2.h's _pluss_histogram_print), so the container swap
+# cannot change dump content or order.
+_PHMAP_STUB = """\
+#ifndef PHMAP_STUB_H
+#define PHMAP_STUB_H
+#include <unordered_map>
+namespace phmap {
+template <class K, class V>
+using flat_hash_map = std::unordered_map<K, V>;
+}
+#endif
+"""
+
 
 # Deterministic replacement for the libc rand() stream, injected into
 # the r10 build via -include. The r10 sampler never calls srand (its
@@ -93,20 +113,22 @@ def _build_reference(
     real reference at odd geometries too, not just the default 4x4.
     `variant` picks the sampler source: "ri-omp-seq" (the serial
     accuracy oracle), "ri-omp" (the PARA binary run.sh's acc protocol
-    pairs with it; its omp pragma pins num_threads(1)), or
-    "rs-ri-opt-r10" (the random-start sampled binary, built with the
-    deterministic rand shim above and -pthread for its six sampler
-    threads).
+    pairs with it; its omp pragma pins num_threads(1)), "ri-opt" (the
+    fused-body sampler linking runtime v2 + the vendored
+    parallel-hashmap, Makefile:22-23), or "rs-ri-opt-r10" (the
+    random-start sampled binary, built with the deterministic rand
+    shim above and -pthread for its six sampler threads).
     """
     if not os.path.isdir(REF):
         pytest.skip("reference checkout not present")
     if shutil.which("g++") is None:
         pytest.skip("no C++ toolchain")
 
+    runtime_src = "pluss_utils_v2" if variant == "ri-opt" else "pluss_utils"
     sources = [
         f"{REF}/sampler/gemm-t4-pluss-pro-model-{variant}.cpp",
         f"{REF}/runtime/pluss.cpp",
-        f"{REF}/runtime/pluss_utils.cpp",
+        f"{REF}/runtime/{runtime_src}.cpp",
     ]
     shim = _RAND_SHIM if variant == "rs-ri-opt-r10" else ""
     # Flags from the reference Makefile:20-21, minus GSL/LTO (stubbed /
@@ -124,8 +146,10 @@ def _build_reference(
     h = hashlib.sha256()
     h.update(_GSL_RANDIST_STUB.encode())
     h.update(shim.encode())
+    if variant == "ri-opt":
+        h.update(_PHMAP_STUB.encode())
     h.update(" ".join(cmd_tail).encode())
-    for src in sources + [f"{REF}/runtime/pluss.h", f"{REF}/runtime/pluss_utils.h"]:
+    for src in sources + [f"{REF}/runtime/pluss.h", f"{REF}/runtime/{runtime_src}.h"]:
         with open(src, "rb") as f:
             h.update(f.read())
     cached = os.path.join(
@@ -141,6 +165,10 @@ def _build_reference(
     (gsl / "gsl_randist.h").write_text(_GSL_RANDIST_STUB)
     (gsl / "gsl_rng.h").write_text(_EMPTY_GUARD.format("RNG"))
     (gsl / "gsl_cdf.h").write_text(_EMPTY_GUARD.format("CDF"))
+    if variant == "ri-opt":
+        ph = build / "parallel_hashmap"
+        ph.mkdir()
+        (ph / "phmap.h").write_text(_PHMAP_STUB)
 
     out = build / "ri-omp-seq"
     pre = []
@@ -226,6 +254,84 @@ def test_acc_dump_matches_reference(tmp_path_factory, threads, chunk):
         )
 
     assert _max_iterations(ours.stdout) == _max_iterations(ref.stdout)
+
+
+@pytest.mark.parametrize("threads,chunk", GEOMETRIES, ids=lambda v: str(v))
+def test_acc_dump_matches_reference_v2_ri_opt(
+    tmp_path_factory, threads, chunk
+):
+    """Third variant row: the fused-body `ri-opt` binary linking
+    runtime v2 (phmap Histogram, raw noshare keys —
+    pluss_utils_v2.h:915-918) vs our oracle engine under runtime-v2
+    semantics. Its acc mode dumps the three histogram sections and an
+    iteration count (ri-opt.cpp:332-358).
+
+    One quirk is applied to OUR side before the byte-compare instead
+    of being baked into the engine: ri-opt's `#pragma omp parallel for
+    num_threads(1)` runs the tids serially, and every tid except the
+    last breaks at the `!isInBound()` check (ri-opt.cpp:89-92) before
+    reaching the termination block (:274-291) — so only tid
+    THREAD_NUM-1 flushes its surviving LAT entries as -1 and adds its
+    access clock to max_iteration_count. Our engine flushes every
+    tid's survivors (the v1 oracle semantics every other variant
+    shares); the test zeroes the other tids' -1 counts and expects the
+    last tid's access clock, then byte-compares all three sections."""
+    binary = _build_reference(tmp_path_factory, threads, chunk, "ri-opt")
+    ref = subprocess.run(
+        [binary, "acc"], capture_output=True, text=True, timeout=300
+    )
+    assert ref.returncode == 0, ref.stderr
+
+    import numpy as np
+
+    from pluss_sampler_optimization_tpu import MachineConfig
+    from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+    from pluss_sampler_optimization_tpu.models import REGISTRY
+    from pluss_sampler_optimization_tpu.oracle import run_serial
+    from pluss_sampler_optimization_tpu.runtime import report
+    from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
+
+    machine = MachineConfig(thread_num=threads, chunk_size=chunk)
+    prog = REGISTRY["gemm"](128)
+    res = run_serial(prog, machine, v2=True)
+
+    # apply the last-tid-only flush quirk to a copy of the state
+    last = threads - 1
+    for tid in range(threads):
+        if tid != last and -1 in res.state.noshare[tid]:
+            del res.state.noshare[tid][-1]
+
+    lines = report.noshare_dump(res.state)
+    lines += report.share_dump(res.state)
+    lines += report.rih_dump(
+        cri_distribute(res.state, threads, threads)
+    )
+    our_sec = _sections("\n".join(lines))
+    ref_sec = _sections(ref.stdout)
+    # a parse/title drift must fail loudly, not compare zero sections
+    assert set(ref_sec) == {
+        "Start to dump noshare private reuse time",
+        "Start to dump share private reuse time",
+        "Start to dump reuse time",
+    }
+    for title, ref_lines in ref_sec.items():
+        assert our_sec[title] == ref_lines, (
+            f"v2 t{threads}c{chunk} section {title!r} differs"
+        )
+
+    # max_iteration_count == the last tid's access clock: per owned
+    # c0, each ref contributes prod(trips of its inner levels)
+    nt = ProgramTrace(prog, machine).nests[0]
+    owner = np.asarray(
+        nt.schedule.owner_tid(np.arange(nt.nest.loops[0].trip))
+    )
+    per_c0 = sum(
+        int(np.prod([nt.nest.loops[l].trip
+                     for l in range(1, int(nt.tables.ref_levels[j]) + 1)]))
+        for j in range(nt.tables.n_refs)
+    )
+    expect = int((owner == last).sum()) * per_c0
+    assert _max_iterations(ref.stdout) == expect
 
 
 class _DetRand:
