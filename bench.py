@@ -154,6 +154,83 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, list]:
     return ok, evidence
 
 
+def guarded_backend_init(
+    init_fn, timeout_s: float, on_timeout=None, probe_was_cached=True
+):
+    """Run the first backend touches (device claim AND first compile)
+    under a watchdog bounded by the remaining --device-timeout budget.
+
+    Two ways the probe can pass while the main process still hangs:
+    a cached probe marker (< _PROBE_TTL_S old) skips the subprocess
+    probe entirely and the tunnel may have died inside the TTL; or the
+    live probe's jit succeeded and the tunnel/compile service died in
+    the seconds between probe exit and the main process's own init.
+    Either way the main process would block with no bound — exactly
+    the failure mode --device-timeout exists to prevent. The watchdog
+    cannot interrupt a call stuck inside a PJRT plugin's claim loop
+    (Python threads are not killable), so the default timeout action
+    deletes the (possibly stale) marker and re-execs this process with
+    --accel-hang-fallback {cached,live}, which pins the CPU backend
+    before any jax state is touched; the restart records the accurate
+    root cause in the bench JSON. `on_timeout` overrides that action
+    (tests/test_bench.py pins the budget with a recording handler).
+    Returns init_fn()'s result when it completes in time."""
+    import threading
+
+    done = threading.Event()
+
+    def fire():
+        if done.wait(timeout_s):
+            return
+        if on_timeout is not None:
+            on_timeout()
+            return
+        try:
+            os.remove(_PROBE_MARKER)
+        except OSError:
+            pass
+        kind = "cached" if probe_was_cached else "live"
+        sys.stderr.write(
+            f"bench: backend init/first-compile exceeded {timeout_s:.0f}s "
+            f"after a {kind} probe pass; marker deleted, re-executing "
+            "on the CPU backend\n"
+        )
+        sys.stderr.flush()
+        argv = [
+            a for i, a in enumerate(sys.argv)
+            if a != "--accel-hang-fallback"
+            and (i == 0 or sys.argv[i - 1] != "--accel-hang-fallback")
+        ]
+        os.execv(
+            sys.executable,
+            [sys.executable] + argv + ["--accel-hang-fallback", kind],
+        )
+
+    threading.Thread(target=fire, daemon=True).start()
+    try:
+        return init_fn()
+    finally:
+        done.set()
+
+
+def _read_cpu_throttle():
+    """cgroup-v2 CPU throttle counters, or None when unreadable. A
+    contended/quota-limited container shows up here even when loadavg
+    looks calm."""
+    try:
+        with open("/sys/fs/cgroup/cpu.stat") as f:
+            d = dict(
+                line.split() for line in f if len(line.split()) == 2
+            )
+        return {
+            k: int(d[k])
+            for k in ("nr_throttled", "throttled_usec")
+            if k in d
+        }
+    except (OSError, ValueError):
+        return None
+
+
 def _bench_hist_kernel_on_device() -> dict:
     """TPU-only: equality + timing of the Pallas pow2 histogram kernel
     vs the portable scatter-add (`exp_hist`) on a realistic batch.
@@ -229,15 +306,39 @@ def main() -> int:
                     "serial run is infeasible, e.g. GEMM N=8192 at "
                     "~19h of single-core time)")
     ap.add_argument("--device-timeout", type=float, default=240.0,
-                    help="seconds to wait for the accelerator backend "
-                    "before falling back to CPU (0 = trust it)")
+                    help="accelerator budget in seconds, shared by the "
+                    "subprocess probe and the main process's "
+                    "init+first-compile watchdog (the watchdog gets "
+                    "what the probe didn't spend, floored at 30s); "
+                    "on timeout the bench falls back to CPU "
+                    "(0 = trust the backend, no probe, no watchdog)")
+    ap.add_argument("--accel-hang-fallback", choices=["cached", "live"],
+                    default=None, help=argparse.SUPPRESS)  # internal:
+    # set by the guarded_backend_init re-exec when the probe passed
+    # (via a cached marker or a live attempt) but the main process's
+    # backend init/first compile hung; forces the CPU path
     args = ap.parse_args()
 
     device_fallback = False
     probe_evidence: list = []
-    if args.device_timeout > 0:
+    probe_was_cached = False
+    if args.accel_hang_fallback:
+        device_fallback = True
+        how = (
+            "cached accel_ok marker passed the probe"
+            if args.accel_hang_fallback == "cached"
+            else "live probe passed but the tunnel died before the "
+            "main process's own init"
+        )
+        probe_evidence = [{
+            "accel_hang": f"{how}; backend init/first compile then "
+            "hung past the --device-timeout budget; marker deleted "
+            "and process re-executed on the CPU backend"
+        }]
+    elif args.device_timeout > 0:
         ok, probe_evidence = probe_accelerator(args.device_timeout)
         device_fallback = not ok
+        probe_was_cached = probe_evidence == [{"cached": True}]
 
     import jax
 
@@ -276,9 +377,6 @@ def main() -> int:
             )
     prog = REGISTRY[args.model](args.n)
     cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
-    t0 = time.perf_counter()
-    dev = jax.devices()[0]
-    init_s = time.perf_counter() - t0
 
     def timed_engine_run():
         """One timed run; returns (state, work units for the rate)."""
@@ -295,19 +393,60 @@ def main() -> int:
         res = run_stream(prog, machine, chunk_m=args.chunk_m)
         return res.state, res.total_accesses
 
-    # warm-up: compiles every kernel at the run's batch shapes
+    # First backend touches: device claim + warm-up compile of every
+    # kernel at the run's batch shapes. Both can hang on a half-dead
+    # tunnel even after a probe pass (a compile service once failed 25
+    # minutes into warm-up), so on the accelerator path both run under
+    # one watchdog holding the budget the probe didn't spend (floored
+    # at 30s so a slow-but-passing probe still leaves the init a
+    # fighting chance; worst-case total is device_timeout + 30s).
+    stamps: dict = {}
     t0 = time.perf_counter()
-    if args.engine == "sampled":
-        warmup(prog, machine, cfg)
+
+    def first_touch():
+        stamps["dev"] = jax.devices()[0]
+        stamps["init_s"] = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        if args.engine == "sampled":
+            warmup(prog, machine, cfg)
+        else:
+            timed_engine_run()
+        stamps["warmup_s"] = time.perf_counter() - t1
+
+    if not device_fallback and args.device_timeout > 0:
+        probe_spent = sum(
+            e.get("seconds", 0.0) for e in probe_evidence
+        )
+        guarded_backend_init(
+            first_touch,
+            max(30.0, args.device_timeout - probe_spent),
+            probe_was_cached=probe_was_cached,
+        )
     else:
-        timed_engine_run()
-    warmup_s = time.perf_counter() - t0
+        first_touch()
+    dev = stamps["dev"]
+    init_s = stamps["init_s"]
+    warmup_s = stamps["warmup_s"]
 
     times = []
+    rep_stats = []
+    throttle0 = _read_cpu_throttle()
     for _ in range(max(1, args.reps)):
         t0 = time.perf_counter()
+        c0 = time.process_time()
         state, work = timed_engine_run()
-        times.append(time.perf_counter() - t0)
+        w = time.perf_counter() - t0
+        c = time.process_time() - c0
+        times.append(w)
+        # cpu/wall per rep: on a contended host wall inflates while
+        # process CPU stays put, so a low ratio (vs the quiet-host
+        # ratio) self-identifies a load-skewed measurement — the
+        # round-2 driver/judge 98s-vs-54s spread was invisible without
+        # this
+        rep_stats.append({
+            "wall_s": round(w, 4), "cpu_s": round(c, 4),
+            "cpu_wall": round(c / w, 2) if w > 0 else None,
+        })
     t_tpu = sorted(times)[len(times) // 2]  # median
 
     unit_name = "samples" if args.engine == "sampled" else "accesses"
@@ -320,23 +459,32 @@ def main() -> int:
         unit_name: work,
         "engine_s_median": round(t_tpu, 4),
         "engine_s_all": [round(t, 4) for t in times],
+        "rep_cpu_wall": rep_stats,
         "device_init_s": round(init_s, 2),
         "warmup_s": round(warmup_s, 2),
         # load conditions, so throughput claims are reproducible
         "cpus": os.cpu_count(),
         "loadavg_1m": round(os.getloadavg()[0], 2),
     }
+    throttle1 = _read_cpu_throttle()
+    if throttle0 is not None and throttle1 is not None:
+        extra["cgroup_throttle_delta"] = {
+            k: throttle1[k] - throttle0[k] for k in throttle1
+        }
     if str(dev.platform) == "tpu":
         extra["hist_kernel"] = _bench_hist_kernel_on_device()
 
     if device_fallback:
-        attempts = [e for e in probe_evidence if "attempt" in e]
-        probe_s = sum(e.get("seconds", 0.0) for e in attempts)
-        extra["device_fallback"] = (
-            f"accelerator backend did not initialize within "
-            f"{args.device_timeout:.0f}s across {len(attempts)} "
-            f"attempts (total probe {probe_s:.0f}s); ran on CPU"
-        )
+        if args.accel_hang_fallback:
+            extra["device_fallback"] = probe_evidence[0]["accel_hang"]
+        else:
+            attempts = [e for e in probe_evidence if "attempt" in e]
+            probe_s = sum(e.get("seconds", 0.0) for e in attempts)
+            extra["device_fallback"] = (
+                f"accelerator backend did not initialize within "
+                f"{args.device_timeout:.0f}s across {len(attempts)} "
+                f"attempts (total probe {probe_s:.0f}s); ran on CPU"
+            )
         extra["probe"] = probe_evidence
 
     # baseline: native C++ serial full traversal, single core. The

@@ -70,7 +70,7 @@ def initialize_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError:
+    except RuntimeError as e:
         # Backstop for when the internal client probe is UNAVAILABLE
         # (jax._src layout changed) and the cluster was wired up
         # outside this wrapper: the bare auto-detect call is tolerant
@@ -78,7 +78,21 @@ def initialize_distributed(
         # as a no-op rather than crashing the run. When the probe IS
         # available it already answered "not initialized" above, so
         # this RuntimeError is a genuine init failure — re-raise.
-        # Explicit topologies always re-raise.
+        # Explicit topologies always fail, but surface a ValueError of
+        # the same shape the probed path produces (with the
+        # RuntimeError chained) so one user error doesn't read two
+        # different ways depending on the jax version. Without the
+        # probe we cannot tell an external-init collision from a
+        # genuine init failure (e.g. unreachable coordinator), so the
+        # message names both and defers to the chained error.
+        if args != (None, None, None) and _probe_client() is None:
+            raise ValueError(
+                f"jax.distributed.initialize({args}) failed: either "
+                "the cluster was already initialized externally "
+                "(conflicting re-initialization) or initialization "
+                "itself failed — the chained RuntimeError has the "
+                "underlying cause"
+            ) from e
         if args != (None, None, None) or _probe_client() is not None:
             raise
         return

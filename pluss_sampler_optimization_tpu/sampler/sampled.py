@@ -168,7 +168,15 @@ def draw_sample_keys(
     space = 1
     for h in highs:
         space *= h
-    assert space < 1 << 63, "sample space exceeds int64 keys"
+    if space >= 1 << 63:
+        # a bare assert would vanish under python -O and silently draw
+        # from a wrapped range
+        raise NotImplementedError(
+            f"ref {nest_trace.tables.ref_names[ref_idx]}: sample space "
+            f"prod(highs)={space:.3e} exceeds int64 flat keys (2^63); "
+            "the flat-space drawing needs a per-level fallback for "
+            "nests this deep/wide"
+        )
     uniq = np.empty(0, dtype=np.int64)
     while len(uniq) < s:
         need = s - len(uniq)

@@ -1,6 +1,7 @@
 """bench.py must always print one parseable JSON line (the driver
 consumes it unattended)."""
 
+import contextlib
 import json
 import os
 import subprocess
@@ -11,17 +12,34 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402  (repo-root module)
 
 
+@contextlib.contextmanager
+def _marker_absent():
+    """Run with the shared probe-marker cache absent, then restore its
+    prior state — deleting it for good would force the next real bench
+    run to re-probe a healthy backend."""
+    saved = None
+    if os.path.exists(bench._PROBE_MARKER):
+        saved = bench._PROBE_MARKER + ".test-saved"
+        os.replace(bench._PROBE_MARKER, saved)
+    try:
+        yield
+    finally:
+        if os.path.exists(bench._PROBE_MARKER):
+            os.remove(bench._PROBE_MARKER)  # probe succeeded mid-test
+        if saved:
+            os.replace(saved, bench._PROBE_MARKER)
+
+
 def test_probe_budget_contract():
     """The probe must never block past --device-timeout: attempt
     schedule plus the optional relay TCP scan stay within the budget
     (the scan is skipped entirely when the budget cannot absorb it)."""
     import time
 
-    if os.path.exists(bench._PROBE_MARKER):
-        os.remove(bench._PROBE_MARKER)
-    t0 = time.perf_counter()
-    ok, evidence = bench.probe_accelerator(8.0)
-    wall = time.perf_counter() - t0
+    with _marker_absent():
+        t0 = time.perf_counter()
+        ok, evidence = bench.probe_accelerator(8.0)
+        wall = time.perf_counter() - t0
     assert wall <= 8.0 + 3.0  # subprocess spawn slack
     attempts = [e for e in evidence if "attempt" in e]
     assert sum(e["seconds"] for e in attempts) <= 8.0 + 1.0
@@ -29,19 +47,66 @@ def test_probe_budget_contract():
     assert not any("relay_tcp" in e for e in evidence)
     if ok:  # healthy accelerator: nothing more to assert
         return
-    assert attempts and attempts[0]["rc"] in ("timeout", 1)
+    # any non-zero outcome is a valid failure: "timeout", a positive
+    # exit code, or a negative rc when the probe subprocess died on a
+    # signal (OOM kill, crashing PJRT plugin)
+    rc = attempts[0]["rc"]
+    assert rc == "timeout" or rc != 0
+
+
+def test_stale_marker_watchdog_bounds_backend_init():
+    """Round-2 gap: a cached accel_ok marker (< 1h old) skips the
+    subprocess probe, and the main process then touched the backend
+    with NO bound — a tunnel that died inside the marker TTL hung the
+    bench exactly the way --device-timeout exists to prevent. The
+    first backend touch now runs under guarded_backend_init; this pins
+    its budget with a cached marker present and a simulated stuck
+    claim loop."""
+    import threading
+    import time
+
+    with _marker_absent():
+        # a fresh marker: the probe trusts it and skips its attempts
+        os.makedirs(os.path.dirname(bench._PROBE_MARKER), exist_ok=True)
+        with open(bench._PROBE_MARKER, "w"):
+            pass
+        ok, evidence = bench.probe_accelerator(8.0)
+        assert ok and evidence == [{"cached": True}]
+
+        release = threading.Event()
+        fired = []
+
+        def stuck_claim_loop():
+            release.wait(30.0)
+            return "backend"
+
+        t0 = time.perf_counter()
+        out = bench.guarded_backend_init(
+            stuck_claim_loop, 1.0,
+            on_timeout=lambda: (fired.append(True), release.set()),
+        )
+        wall = time.perf_counter() - t0
+        assert fired, "watchdog did not fire on a hung init"
+        assert wall < 5.0, f"budget not enforced: {wall:.1f}s"
+        assert out == "backend"  # init_fn's value still propagates
+
+    # the fast path: a healthy init must not trip the watchdog
+    fired2 = []
+    assert bench.guarded_backend_init(
+        lambda: 42, 5.0, on_timeout=lambda: fired2.append(True)
+    ) == 42
+    assert not fired2
 
 
 def test_bench_emits_json_line():
-    # a cached successful probe would bypass --device-timeout and let
-    # the subprocess block on a stalled accelerator tunnel
-    if os.path.exists(bench._PROBE_MARKER):
-        os.remove(bench._PROBE_MARKER)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"),
-         "--n", "64", "--device-timeout", "1"],
-        capture_output=True, text=True, timeout=900, cwd=REPO,
-    )
+    # marker held absent so --device-timeout is honored end-to-end
+    # (and restored afterward for real bench runs)
+    with _marker_absent():
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--n", "64", "--device-timeout", "1"],
+            capture_output=True, text=True, timeout=900, cwd=REPO,
+        )
     assert proc.returncode == 0, proc.stderr[-2000:]
     json_lines = [
         l for l in proc.stdout.splitlines() if l.startswith("{")
@@ -52,3 +117,7 @@ def test_bench_emits_json_line():
     assert doc["value"] > 0
     assert doc["vs_baseline"] > 0  # native baseline must have run
     assert doc["extra"]["mrc_l1_err"] < 0.05
+    # contention diagnostics: one cpu/wall record per rep
+    reps = doc["extra"]["rep_cpu_wall"]
+    assert len(reps) == len(doc["extra"]["engine_s_all"])
+    assert all(r["cpu_wall"] > 0 for r in reps)

@@ -104,6 +104,31 @@ def test_triangular_nonunit_step_sampled_engine():
         run_sampled(prog, MachineConfig(), SamplerConfig(ratio=0.5, seed=0))
 
 
+def test_sample_space_int64_cap():
+    """Flat-space sample keys are int64 mixed-radix; a nest whose
+    drawable space exceeds 2^63 must raise a typed error (not a bare
+    assert that vanishes under python -O, and never a silently wrapped
+    draw range)."""
+    from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        draw_sample_keys,
+    )
+
+    n = 3_000_000  # (3e6 - 1)^3 > 2^63
+    prog = Program(
+        name="hugespace",
+        nests=(
+            ParallelNest(
+                loops=(Loop(n), Loop(n), Loop(n)),
+                refs=(Ref("A0", "A", level=2, coeffs=(n * n, n, 1)),),
+            ),
+        ),
+    )
+    nt = ProgramTrace(prog, MachineConfig()).nests[0]
+    with pytest.raises(NotImplementedError, match="sample space"):
+        draw_sample_keys(nt, 0, SamplerConfig(ratio=1e-9, seed=0), seed=0)
+
+
 def test_negative_element_index_rejected():
     from pluss_sampler_optimization_tpu.sampler.dense import run_dense
 
