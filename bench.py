@@ -282,10 +282,12 @@ def main() -> int:
     ap.add_argument("--n", type=int, default=4096)
     ap.add_argument("--model", default="gemm")
     ap.add_argument("--engine", default="sampled",
-                    choices=["sampled", "dense", "stream"],
+                    choices=["sampled", "dense", "stream", "periodic"],
                     help="sampled = random-start closed-form engine "
                     "(the r10 equivalent); dense/stream = exact "
-                    "full-traversal engines (the ri/ri-opt speed rows)")
+                    "full-traversal engines (the ri/ri-opt speed "
+                    "rows); periodic = exact engine from O(1) "
+                    "two-period windows (sampler/periodic.py)")
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--reps", type=int, default=3,
@@ -387,6 +389,13 @@ def main() -> int:
             from pluss_sampler_optimization_tpu.sampler.dense import run_dense
 
             res = run_dense(prog, machine)
+            return res.state, res.total_accesses
+        if args.engine == "periodic":
+            from pluss_sampler_optimization_tpu.sampler.periodic import (
+                run_periodic,
+            )
+
+            res = run_periodic(prog, machine)
             return res.state, res.total_accesses
         from pluss_sampler_optimization_tpu.sampler.stream import run_stream
 

@@ -47,7 +47,16 @@ def _run_engine(engine: str, program, machine, args):
     if engine == "oracle":
         from .oracle.serial import run_serial
 
-        return run_serial(program, machine, v2=args.runtime == "v2"), None
+        return run_serial(
+            program, machine, v2=args.runtime == "v2",
+            schedule=args.schedule,
+        ), None
+    if args.schedule == "dynamic":
+        raise SystemExit(
+            "--schedule dynamic is modeled by the oracle engine only "
+            "(the reference's dynamic dispatcher arm is dead code with "
+            "no live sampler; use --engine oracle)"
+        )
     if engine == "numpy":
         from .oracle.numpy_ref import run_numpy
 
@@ -68,6 +77,10 @@ def _run_engine(engine: str, program, machine, args):
         from .sampler.stream import run_stream
 
         return run_stream(program, machine), None
+    if engine == "periodic":
+        from .sampler.periodic import run_periodic
+
+        return run_periodic(program, machine), None
     if engine in ("sampled", "sharded"):
         from .config import SamplerConfig
 
@@ -115,10 +128,17 @@ def main(argv=None) -> int:
         "--engine",
         default=None,
         help="oracle | numpy | native | native-par | dense | stream | "
-        "sampled | sharded (default: dense; sample mode forces sampled)",
+        "periodic | sampled | sharded (default: dense; sample mode "
+        "forces sampled)",
     )
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--schedule", choices=["static", "dynamic"],
+                    default="static",
+                    help="chunk ownership: static round-robin (the "
+                    "reference's live path) or the FIFO dynamic "
+                    "dispatcher arm (oracle engine only; equals "
+                    "static for rectangular nests)")
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--pallas-hist", default=None,

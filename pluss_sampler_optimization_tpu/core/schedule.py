@@ -163,3 +163,40 @@ def interleaved_order_key(nest_trace, ref_idx: int, samples):
         # range up to the nest-wide max trip
         key = key * int(nest_trace.max_trips[l]) + samples[:, l]
     return key
+
+
+def dynamic_chunk_assignment(n_chunks: int, threads: int, chunk_costs):
+    """FIFO chunk handout of the reference's dynamic dispatcher arm.
+
+    `ChunkDispatcher.hasNextChunk(false)` / `getNextChunk`
+    (pluss_utils.h:367-409; Rust stub surface chunk_dispatcher.rs:34-69)
+    hand chunks to requesting threads in arrival order instead of the
+    static round-robin. No live reference sampler calls this arm (every
+    generated sampler passes isStatic=true or uses the static API), so
+    there is no generated-code behavior to byte-match; the model here
+    follows the uniform-interleaving machine the rest of the framework
+    simulates: every simulated thread advances one access per turn, a
+    thread requests its next chunk on the turn its current chunk
+    completes, and simultaneous requests are served in tid order (the
+    worker-list iteration order of the generated walks).
+
+    With equal chunk costs — every rectangular nest, where each parallel
+    iteration performs the same accesses — each request round resolves
+    in tid order and the assignment IS the static round-robin; that
+    closed-form equivalence is why the static arm alone reproduces the
+    reference's live behavior (tests/test_schedule.py pins it). Costs
+    only diverge for triangular nests.
+
+    `chunk_costs[i]` = accesses in chunk i; returns per-tid lists of
+    chunk indices in execution order.
+    """
+    import heapq
+
+    ready = [(0, t) for t in range(threads)]
+    heapq.heapify(ready)
+    out: list = [[] for _ in range(threads)]
+    for ci in range(n_chunks):
+        turn, tid = heapq.heappop(ready)
+        out[tid].append(ci)
+        heapq.heappush(ready, (turn + int(chunk_costs[ci]), tid))
+    return out

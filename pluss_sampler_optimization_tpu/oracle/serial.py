@@ -44,11 +44,16 @@ class OracleResult:
 
 
 def run_serial(
-    program: Program, machine: MachineConfig, v2: bool = False
+    program: Program, machine: MachineConfig, v2: bool = False,
+    schedule: str = "static",
 ) -> OracleResult:
     """v2=True selects the runtime-v2 histogram semantics (raw noshare
-    keys, pluss_utils_v2.h:915-918)."""
-    from ..core.schedule import StaticSchedule
+    keys, pluss_utils_v2.h:915-918). schedule="dynamic" replaces the
+    static round-robin chunk ownership with the reference's FIFO
+    dynamic-dispatcher arm (core/schedule.py::dynamic_chunk_assignment
+    — dead code in the reference, modeled under uniform interleaving;
+    identical to static for every rectangular nest)."""
+    from ..core.schedule import StaticSchedule, dynamic_chunk_assignment
 
     P = machine.thread_num
     state = PRIState(P, bin_noshare=not v2)
@@ -100,9 +105,42 @@ def run_serial(
             for ref in post[level]:
                 access(tid, ref, ivs)
 
-        for tid in range(P):
-            for m in range(sched.local_count(tid)):
-                body(tid, 0, [sched.local_to_value(tid, m)])
+        if schedule == "dynamic":
+            n_chunks = -(-lp0.trip // machine.chunk_size)
+
+            def period_cost(n: int) -> int:
+                v0 = lp0.start + n * lp0.step
+                total = 0
+                for l in range(depth):
+                    width = 1
+                    for j in range(1, l + 1):
+                        width *= nest.loops[j].trip_at(v0)
+                    total += (len(pre[l]) + len(post[l])) * width
+                return total
+
+            costs = [
+                sum(
+                    period_cost(n)
+                    for n in range(
+                        ci * machine.chunk_size,
+                        min((ci + 1) * machine.chunk_size, lp0.trip),
+                    )
+                )
+                for ci in range(n_chunks)
+            ]
+            for tid, chunks in enumerate(
+                dynamic_chunk_assignment(n_chunks, P, costs)
+            ):
+                for ci in chunks:
+                    for n in range(
+                        ci * machine.chunk_size,
+                        min((ci + 1) * machine.chunk_size, lp0.trip),
+                    ):
+                        body(tid, 0, [lp0.start + n * lp0.step])
+        else:
+            for tid in range(P):
+                for m in range(sched.local_count(tid)):
+                    body(tid, 0, [sched.local_to_value(tid, m)])
 
         # per-nest -1 flush + LAT clear (...ri-omp-seq.cpp:303-319)
         for tid in range(P):

@@ -309,9 +309,76 @@ def dense_nest_outputs(program: Program, machine: MachineConfig,
     return outs
 
 
+def dense_bytes_estimate(program: Program, machine: MachineConfig) -> int:
+    """Predicted peak bytes of the one-shot dense sort, from the trace
+    geometry alone: per nest, the vmapped kernel materializes every
+    tid's padded per-ref grids as int64 keys (lmax x inner sizes,
+    packed_ref_keys), concatenates, and sorts — XLA holds roughly the
+    keys plus the sorted copy plus the derived pos/grp/ref columns, so
+    4x the key bytes is the working-set estimate the router uses."""
+    trace = ProgramTrace(program, machine)
+    total = 0
+    for nt in trace.nests:
+        sched = nt.schedule
+        lmax = sched.max_local_count()
+        per_m = 0
+        for ri in range(nt.tables.n_refs):
+            sz = 1
+            for l in range(1, int(nt.tables.ref_levels[ri]) + 1):
+                sz *= (nt.max_trips[l] if nt.tri
+                       else nt.nest.loops[l].trip)
+            per_m += sz
+        total += machine.thread_num * lmax * per_m
+    return total * 8 * 4
+
+
+def _available_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 1 << 62  # unknown: never route
+
+
 def run_dense(program: Program, machine: MachineConfig,
-              max_share: int = 64, tid_sharding=None) -> OracleResult:
-    """Dense TPU sampler -> host PRIState (same shape as the oracles)."""
+              max_share: int = 64, tid_sharding=None,
+              auto_route: bool = True) -> OracleResult:
+    """Dense TPU sampler -> host PRIState (same shape as the oracles).
+
+    With `auto_route` (default), a run whose predicted sort working
+    set exceeds available host memory is routed to an equivalent exact
+    engine instead of letting XLA OOM (GEMM N=1024 requests ~279 GB on
+    a 125 GB host): the periodic engine when its preconditions hold,
+    else the streaming engine. Both produce bit-identical PRIStates.
+    """
+    if auto_route and tid_sharding is None:
+        est = dense_bytes_estimate(program, machine)
+        avail = _available_bytes()
+        if est > 0.6 * avail:
+            import sys as _sys
+
+            from .periodic import run_periodic, validate_periodic
+
+            try:
+                validate_periodic(program, machine)
+                routed = "periodic"
+            except NotImplementedError:
+                routed = "stream"
+            print(
+                f"dense: predicted sort working set "
+                f"{est / 1e9:.0f} GB exceeds available "
+                f"{avail / 1e9:.0f} GB; routing to the {routed} "
+                "engine (bit-identical output)",
+                file=_sys.stderr,
+            )
+            if routed == "periodic":
+                return run_periodic(program, machine, max_share)
+            from .stream import run_stream
+
+            return run_stream(program, machine, max_share=max_share)
     trace, outs = _run_outputs(program, machine, max_share, tid_sharding)
     P = machine.thread_num
     state = PRIState(P)

@@ -1,0 +1,157 @@
+"""Periodic exact engine vs the numpy oracle: bit-exact parity, sound
+rejection of nests where a reuse could skip a period, and the dense
+engine's memory auto-route."""
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import (
+    Loop,
+    MachineConfig,
+    ParallelNest,
+    Program,
+    Ref,
+)
+from pluss_sampler_optimization_tpu.models import (
+    gemm,
+    heat3d,
+    jacobi2d,
+    mm2,
+    mm3,
+    mvt,
+    syrk_rect,
+    syrk_tri,
+)
+from pluss_sampler_optimization_tpu.oracle import run_numpy
+from pluss_sampler_optimization_tpu.sampler.periodic import (
+    run_periodic,
+    validate_periodic,
+)
+
+PROGRAMS = [
+    gemm(16),
+    gemm(13),  # ragged: short last chunk
+    gemm(32),
+    mm2(8),
+    mm3(6),
+    jacobi2d(10, tsteps=2),
+    heat3d(16),  # stencil union -> equal-c0 window tier
+    mvt(16),  # transposed single ref -> exhaustive tier
+]
+
+
+def _assert_bit_exact(program, machine):
+    a = run_numpy(program, machine)
+    b = run_periodic(program, machine)
+    P = machine.thread_num
+    assert a.total_accesses == b.total_accesses
+    for t in range(P):
+        assert a.state.noshare[t] == b.state.noshare[t], (program.name, t)
+        assert a.state.share[t] == b.state.share[t], (program.name, t)
+    assert a.per_tid_accesses == b.per_tid_accesses
+
+
+@pytest.mark.parametrize("program", PROGRAMS, ids=lambda p: p.name)
+def test_periodic_matches_oracle(program):
+    _assert_bit_exact(program, MachineConfig())
+
+
+@pytest.mark.parametrize("threads,chunk", [(3, 5), (7, 3)],
+                         ids=lambda v: str(v))
+def test_periodic_odd_geometries(threads, chunk):
+    # odd geometries change the in-tid period sequence (jump deltas,
+    # ragged tails) — exactly what the signature decomposition models
+    machine = MachineConfig(thread_num=threads, chunk_size=chunk)
+    _assert_bit_exact(gemm(13), machine)
+    _assert_bit_exact(mm2(8), machine)
+
+
+def test_periodic_rejects_triangular():
+    with pytest.raises(NotImplementedError, match="triangular"):
+        validate_periodic(syrk_tri(9), MachineConfig())
+
+
+def test_periodic_rejects_mixed_parallel_coefficients():
+    """syrk's A[i][k] (c0=N) and A[j][k] (c0=0) share array A: the
+    window histogram then depends on the absolute parallel value (the
+    fixed ref re-touches the translating ref's row at a v0-dependent
+    position), so representative-window scaling is unsound even though
+    reuses never skip a period. Regression for a round-3 review
+    finding: N=8 (one cache line per row) masked the divergence, N=10
+    exposed it — the validator must reject every size."""
+    for n in (8, 10, 16):
+        with pytest.raises(NotImplementedError, match="mix parallel"):
+            validate_periodic(syrk_rect(n), MachineConfig())
+
+
+def test_periodic_rejects_period_skipping_reuse():
+    """Two refs on one array whose windows touch a line several
+    periods apart with nothing between: the exhaustive tier must
+    reject (accepting would record a cold miss where the oracle
+    records a long reuse)."""
+    n = 16
+    prog = Program(
+        name="skipgap",
+        nests=(
+            ParallelNest(
+                loops=(Loop(n), Loop(2)),
+                refs=(
+                    Ref("A0", "A", level=1, coeffs=(8, 1)),
+                    Ref("A1", "A", level=1, coeffs=(8, 1), const=32),
+                ),
+            ),
+        ),
+    )
+    with pytest.raises(NotImplementedError):
+        validate_periodic(prog, MachineConfig())
+    # and the oracle confirms the period-skipping reuse is real: on a
+    # single simulated thread (where periods are consecutive), A1 at
+    # period q and A0 at period q+4 touch the same line — raw distance
+    # 13 accesses (4-period skip x 4 accesses/period - 3), pow2-binned
+    # to 8, far beyond anything a two-period window could see. The
+    # only shorter reuses in this model are the within-period distance
+    # 2 pairs.
+    one = MachineConfig(thread_num=1)
+    with pytest.raises(NotImplementedError):
+        validate_periodic(prog, one)
+    res = run_numpy(prog, one)
+    assert 8 in res.state.noshare[0], sorted(res.state.noshare[0])
+
+
+def test_dense_auto_routes_past_memory_cliff(monkeypatch, capsys):
+    """run_dense must reroute (not OOM) when the predicted sort
+    working set exceeds available memory, and the routed result stays
+    bit-identical."""
+    from pluss_sampler_optimization_tpu.sampler import dense as D
+
+    prog = gemm(16)
+    machine = MachineConfig()
+    want = run_numpy(prog, machine)
+    monkeypatch.setattr(D, "_available_bytes", lambda: 1024)
+    routed = D.run_dense(prog, machine)
+    err = capsys.readouterr().err
+    assert "routing to the periodic engine" in err
+    for t in range(4):
+        assert routed.state.noshare[t] == want.state.noshare[t]
+        assert routed.state.share[t] == want.state.share[t]
+    # a model the periodic engine rejects routes to stream instead
+    tri = syrk_tri(9)
+    want_tri = run_numpy(tri, machine)
+    routed_tri = D.run_dense(tri, machine)
+    err = capsys.readouterr().err
+    assert "routing to the stream engine" in err
+    for t in range(4):
+        assert routed_tri.state.noshare[t] == want_tri.state.noshare[t]
+
+
+def test_dense_bytes_estimate_scales():
+    """The estimate must grow ~N^3 for GEMM and predict the recorded
+    N=1024 cliff (>200 GB, BASELINE.md) while N=128 stays small."""
+    from pluss_sampler_optimization_tpu.sampler.dense import (
+        dense_bytes_estimate,
+    )
+
+    small = dense_bytes_estimate(gemm(128), MachineConfig())
+    big = dense_bytes_estimate(gemm(1024), MachineConfig())
+    assert small < 2e9
+    assert big > 100e9
