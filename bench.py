@@ -236,8 +236,9 @@ def _bench_hist_kernel_on_device() -> dict:
     vs the portable scatter-add (`exp_hist`) on a realistic batch.
 
     Runs only when the bench actually landed on a TPU, so BENCH JSON
-    carries device-executed evidence for the kernel that the sharded
-    engine now uses by default (SamplerConfig.use_pallas_hist).
+    carries device-executed evidence for the kernel. The kernel is
+    default-OFF (SamplerConfig.use_pallas_hist) until this block's
+    measurement justifies flipping it on.
     """
     import numpy as np
 

@@ -144,9 +144,10 @@ def main(argv=None) -> int:
     ap.add_argument("--pallas-hist", default=None,
                     action=argparse.BooleanOptionalAction,
                     help="sharded engine: reduce histograms with the "
-                    "Pallas TPU kernel (the config default; it falls "
-                    "back to portable scatter-add off-TPU); "
-                    "--no-pallas-hist forces scatter-add everywhere")
+                    "Pallas TPU kernel instead of the portable "
+                    "scatter-add (config default: OFF until an "
+                    "on-device measurement justifies it; the kernel "
+                    "only ever engages on a TPU backend)")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
     ap.add_argument("--min-reuse", type=int, default=512,
