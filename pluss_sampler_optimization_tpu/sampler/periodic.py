@@ -308,17 +308,21 @@ def _inner_max(nt: NestTrace, ri: int) -> int:
 
 
 def _phase_count(nt: NestTrace) -> int:
-    """Distinct per-period structures induced by line-granule rounding:
-    the grouping pattern depends on (c0 * v0 + const) mod (cls/ds) per
-    array, so v0 mod granule covers every case; collapse to 1 when all
-    parallel coefficients are granule-aligned."""
+    """Distinct per-period structures induced by line-granule rounding.
+
+    The grouping pattern of a period at parallel value v0 depends on
+    (c0 * v0) mod (cls/ds) per ref: successive periods differ by
+    c0 * step there, so the pattern is identical for EVERY period —
+    one phase — exactly when (c0 * step) % granule == 0 for every ref
+    (the constant c0 * start offset is shared by all periods and
+    cancels). Otherwise v0 mod granule covers every possible class."""
     t = nt.tables
     g = max(1, nt.machine.cls // nt.machine.ds)
+    step = nt.nest.loops[0].step
     if all(
-        int(t.ref_coeffs[ri][0]) % g == 0 for ri in range(t.n_refs)
-    ) and (nt.nest.loops[0].step % g == 0 or all(
-        int(t.ref_coeffs[ri][0]) == 0 for ri in range(t.n_refs)
-    )):
+        (int(t.ref_coeffs[ri][0]) * step) % g == 0
+        for ri in range(t.n_refs)
+    ):
         return 1
     return g
 
