@@ -535,7 +535,13 @@ def main() -> int:
 
             flush_cache()
             t0 = time.perf_counter()
-            base = native.run_serial_native(sprog, machine)
+            # generous share capacity up front: an undersized buffer
+            # silently regrows and RE-WALKS inside this timed window,
+            # doubling the reported serial time (triangular nests need
+            # ~1e5-1e6 pairs; 1<<20 covers every recorded config)
+            base = native.run_serial_native(
+                sprog, machine, share_cap=1 << 20
+            )
             t_cpp = time.perf_counter() - t0
             base_state = base.state
             acc, how = base.total_accesses, "serial_cpp_s"

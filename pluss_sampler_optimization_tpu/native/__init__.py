@@ -131,36 +131,46 @@ def _run_native(
     ratios = _i64(np.concatenate([t.ref_share_ratios for t in tables]))
 
     P = machine.thread_num
-    noshare_bins = np.zeros(P * _NOSHARE_SLOTS, dtype=np.int64)
-    share_out = np.zeros(share_cap * 4, dtype=np.int64)
-    share_count = np.zeros(1, dtype=np.int64)
-    per_tid = np.zeros(P, dtype=np.int64)
+    while True:
+        noshare_bins = np.zeros(P * _NOSHARE_SLOTS, dtype=np.int64)
+        share_out = np.zeros(share_cap * 4, dtype=np.int64)
+        share_count = np.zeros(1, dtype=np.int64)
+        per_tid = np.zeros(P, dtype=np.int64)
 
-    rc = lib.pluss_run(
-        ctypes.c_int64(1 if parallel else 0),
-        ctypes.c_int64(P),
-        ctypes.c_int64(machine.chunk_size),
-        ctypes.c_int64(machine.ds),
-        ctypes.c_int64(machine.cls),
-        ctypes.c_int64(n_nests),
-        _ptr(depths), _ptr(trips), _ptr(starts), _ptr(steps),
-        _ptr(trip_cf), _ptr(start_cf),
-        _ptr(ref_off), _ptr(levels), _ptr(coeffs), _ptr(consts),
-        _ptr(arrays), _ptr(slots), _ptr(thrs), _ptr(ratios),
-        ctypes.c_int64(len(program.arrays)),
-        _ptr(noshare_bins), _ptr(share_out), _ptr(share_count),
-        ctypes.c_int64(share_cap), _ptr(per_tid),
-    )
-    if rc == 2:
-        raise RuntimeError(
-            "native parallel execution failed (thread spawn or worker "
-            "exception)"
+        rc = lib.pluss_run(
+            ctypes.c_int64(1 if parallel else 0),
+            ctypes.c_int64(P),
+            ctypes.c_int64(machine.chunk_size),
+            ctypes.c_int64(machine.ds),
+            ctypes.c_int64(machine.cls),
+            ctypes.c_int64(n_nests),
+            _ptr(depths), _ptr(trips), _ptr(starts), _ptr(steps),
+            _ptr(trip_cf), _ptr(start_cf),
+            _ptr(ref_off), _ptr(levels), _ptr(coeffs), _ptr(consts),
+            _ptr(arrays), _ptr(slots), _ptr(thrs), _ptr(ratios),
+            ctypes.c_int64(len(program.arrays)),
+            _ptr(noshare_bins), _ptr(share_out), _ptr(share_count),
+            ctypes.c_int64(share_cap), _ptr(per_tid),
         )
-    if rc != 0:
-        raise RuntimeError(
-            f"native share capacity exceeded: need {int(share_count[0])}, "
-            f"have {share_cap}"
-        )
+        if rc == 2:
+            raise RuntimeError(
+                "native parallel execution failed (thread spawn or "
+                "worker exception)"
+            )
+        if rc == 0:
+            break
+        # capacity overflow: the ABI reports the exact required pair
+        # count in share_count without corrupting anything, so regrow
+        # once and re-walk (triangular nests at large N produce ~1e5+
+        # distinct share (tid, ratio, value) triples — syrk-tri N=2048
+        # needs ~4.6e5 — far past any useful fixed default)
+        need = int(share_count[0])
+        if need <= share_cap:  # defensive: rc!=0 must imply growth
+            raise RuntimeError(
+                f"native share capacity exceeded: need {need}, "
+                f"have {share_cap}"
+            )
+        share_cap = need
 
     state = PRIState(P)
     bins = noshare_bins.reshape(P, _NOSHARE_SLOTS)

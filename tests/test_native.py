@@ -51,9 +51,14 @@ def test_native_odd_machine():
     _results_equal(run_serial(prog, m), native.run_serial_native(prog, m))
 
 
-def test_native_share_capacity_error():
-    with pytest.raises(RuntimeError):
-        native.run_serial_native(gemm(24), MACHINE, share_cap=1)
+def test_native_share_capacity_regrows():
+    """An undersized share capacity regrows from the ABI-reported need
+    and re-walks instead of raising (syrk-tri N=2048 needs ~4.6e5
+    pairs, far past any useful fixed default); the result must match a
+    comfortably-sized run bit for bit."""
+    small = native.run_serial_native(gemm(24), MACHINE, share_cap=1)
+    big = native.run_serial_native(gemm(24), MACHINE, share_cap=1 << 16)
+    _results_equal(small, big)
 
 
 @pytest.mark.parametrize(

@@ -28,6 +28,11 @@ def main() -> int:
                     help="timed repetitions; the stored wall time is "
                     "the median (the reference's speed mode runs 10; "
                     "1 is the pragmatic default for hour-long configs)")
+    ap.add_argument("--share-cap", type=int, default=1 << 20,
+                    help="native share-pair buffer size; an undersized "
+                    "buffer regrows and RE-WALKS, which would silently "
+                    "double every timed rep (triangular nests at large "
+                    "N need ~1e5-1e6 pairs)")
     args = ap.parse_args()
 
     import jax
@@ -46,7 +51,7 @@ def main() -> int:
     for _ in range(max(1, args.reps)):
         flush_cache()  # reference flushes before timing (pluss.cpp:71-94)
         t0 = time.perf_counter()
-        res = run_serial_native(prog, machine)
+        res = run_serial_native(prog, machine, share_cap=args.share_cap)
         times.append(time.perf_counter() - t0)
     secs = sorted(times)[len(times) // 2]
     conditions = {
