@@ -42,6 +42,13 @@ The reference has no analog: its exact samplers walk the full trace
 the closed-form restructuring the TPU design buys — the same move that
 turned the r10 walk into vectorized next-use solves (sampler/
 sampled.py), applied to the exact path.
+
+Multi-chip note: this engine deliberately has no sharded variant. Its
+entire device workload is 2-3 windows of one sort each — there is no
+long axis to lay over a mesh, which is precisely why it is fast. The
+mesh-parallel exact paths are run_dense_sharded (simulated-thread axis
+over devices) and the sampled engine's sample-axis shard_map
+(parallel/sharded.py); programs this engine rejects fall back to them.
 """
 
 from __future__ import annotations
