@@ -81,6 +81,10 @@ def _run_engine(engine: str, program, machine, args):
         from .sampler.periodic import run_periodic
 
         return run_periodic(program, machine), None
+    if engine == "exact":
+        from .sampler.periodic import run_exact
+
+        return run_exact(program, machine), None
     if engine in ("sampled", "sharded"):
         from .config import SamplerConfig
 
@@ -128,8 +132,10 @@ def main(argv=None) -> int:
         "--engine",
         default=None,
         help="oracle | numpy | native | native-par | dense | stream | "
-        "periodic | sampled | sharded (default: dense; sample mode "
-        "forces sampled)",
+        "periodic | exact | sampled | sharded (default: dense; sample "
+        "mode forces sampled; 'exact' picks the fastest applicable "
+        "exact engine: periodic when its preconditions hold, else "
+        "dense with its memory auto-route)",
     )
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--chunk", type=int, default=4)
@@ -218,8 +224,8 @@ def main(argv=None) -> int:
                 "--diff-against compares acc/sample dumps; it has no "
                 "meaning in speed or trace mode"
             )
-        _ENGINES = ("oracle", "numpy", "native", "dense", "stream",
-                    "sampled", "sharded")
+        _ENGINES = ("oracle", "numpy", "native", "native-par", "dense",
+                    "stream", "periodic", "exact", "sampled", "sharded")
         if args.diff_against not in _ENGINES:
             raise SystemExit(
                 f"unknown --diff-against engine {args.diff_against!r} "

@@ -460,6 +460,23 @@ def validate_periodic(program: Program, machine: MachineConfig) -> None:
         _validate_nest(program, k, machine)
 
 
+def run_exact(program: Program, machine: MachineConfig,
+              max_share: int = 64) -> OracleResult:
+    """Fastest applicable exact engine: periodic when its
+    preconditions hold, else dense — whose own auto-route covers the
+    memory ceiling by falling to stream. All three produce
+    bit-identical PRIStates (tests), so callers wanting "the exact
+    histogram, fast" need no engine knowledge. The CLI's
+    `--engine exact` is this function."""
+    try:
+        validate_periodic(program, machine)
+    except NotImplementedError:
+        from .dense import run_dense
+
+        return run_dense(program, machine, max_share)
+    return run_periodic(program, machine, max_share)
+
+
 def run_periodic(program: Program, machine: MachineConfig,
                  max_share: int = 64) -> OracleResult:
     """Periodic exact engine -> host PRIState (== run_dense exactly)."""

@@ -17,7 +17,7 @@ def _dump(capsys, argv):
 
 def test_acc_dumps_identical_across_engines(capsys):
     outs = {}
-    engines = ["oracle", "numpy", "dense", "stream"]
+    engines = ["oracle", "numpy", "dense", "stream", "periodic", "exact"]
     try:
         from pluss_sampler_optimization_tpu import native
 
@@ -32,6 +32,16 @@ def test_acc_dumps_identical_across_engines(capsys):
     base = outs["oracle"]
     for engine, out in outs.items():
         assert out == base, f"{engine} dumps differ from oracle"
+
+
+def test_exact_engine_falls_back_when_periodic_rejects(capsys):
+    """--engine exact must route triangular models (periodic-rejected)
+    through the dense path and still match the oracle byte for byte."""
+    a = _dump(capsys, ["acc", "--model", "trmm", "--n", "9",
+                       "--engine", "exact"])
+    b = _dump(capsys, ["acc", "--model", "trmm", "--n", "9",
+                       "--engine", "oracle"])
+    assert a == b
 
 
 def test_speed_mode(capsys):
