@@ -106,8 +106,9 @@ def _random_machine(seed: int) -> MachineConfig:
     )
 
 
-# 20 in CI (~75 s both checks); seeds 20-299 swept clean offline for
-# the dense and periodic checks (2026-07-31, zero mismatches)
+# 20 in CI (~75 s both checks); swept clean offline with zero
+# mismatches (2026-07-31): dense and periodic seeds 20-299, stream
+# seeds 20-119
 SEEDS = list(range(20))
 
 
