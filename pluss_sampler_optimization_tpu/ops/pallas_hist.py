@@ -25,27 +25,47 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 N_BINS = 64
 _LANES = 128
 _BLOCK_ROWS = 8
+# numpy (not jnp): a module-level jnp scalar would initialize the
+# backend at import time, before _platform pinning can take effect
+_I0 = np.int32(0)
 
 
 def _hist_kernel(hi_ref, lo_ref, w_ref, out_ref):
+    # One (64,128) output block shared by every grid step (Mosaic
+    # requires output blocks tiled to (8,128); a (1,128) row per step
+    # fails to lower). TPU grid steps run sequentially, so step 0
+    # zeroes the block and each later step accumulates into it.
+    #
+    # Row k holds PER-LANE partial counts for threshold 2^k; the
+    # cheap cross-lane sum happens outside the kernel. Reducing to a
+    # scalar in-kernel is a trap under x64: Mosaic's scalar-reduction
+    # proxy re-enters jnp.sum without a dtype (mosaic/lowering.py,
+    # reduce_lowering_rule _proxy_fun), which promotes int32 to int64
+    # and fails to lower. Sublane (axis 0) reductions avoid the proxy.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
     hi = hi_ref[:]
     lo = lo_ref[:]
     w = w_ref[:]
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
-    acc = jnp.zeros((1, _LANES), dtype=jnp.int32)
+    rows = []
     for k in range(N_BINS):
         if k < 32:
             ge = (hi > 0) | (lo >= jnp.uint32(1 << k))
         else:
             ge = hi >= jnp.uint32(1 << (k - 32))
-        c_k = jnp.sum(jnp.where(ge, w, jnp.int32(0)))
-        acc = acc + jnp.where(lane == k, c_k, jnp.int32(0))
-    out_ref[:] = acc
+        # dtype pinned: under x64, jnp.sum(int32) promotes to int64,
+        # which Mosaic cannot lower
+        rows.append(jnp.sum(jnp.where(ge, w, jnp.int32(0)), axis=0,
+                            keepdims=True, dtype=jnp.int32))
+    out_ref[:] += jnp.concatenate(rows, axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -54,9 +74,9 @@ def pow2_hist(values, weights, interpret: bool = False):
 
     `values` int64 (> 0 where weights are nonzero); `weights` are added
     per entry like exp_hist (bool masks and int32-range counts; the
-    per-block partial sums are int32, so keep per-call weight totals
-    below 2^31). Equivalent to ops/histogram.py::exp_hist within that
-    range.
+    kernel accumulates per-lane partials in int32 across ALL grid
+    steps of a call, so keep per-call weight totals below 2^31).
+    Equivalent to ops/histogram.py::exp_hist within that range.
     """
     values = values.ravel().astype(jnp.int64)
     w = weights.ravel().astype(jnp.int32)
@@ -76,18 +96,21 @@ def pow2_hist(values, weights, interpret: bool = False):
 
     partial = pl.pallas_call(
         _hist_kernel,
-        out_shape=jax.ShapeDtypeStruct((grid, _LANES), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((N_BINS, _LANES), jnp.int32),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+            # the 0 column index must be int32: under x64 a Python 0
+            # traces as i64 and Mosaic refuses the (i32, i64) index-map
+            # return
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, _I0)),
         ],
-        out_specs=pl.BlockSpec((1, _LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((N_BINS, _LANES), lambda i: (_I0, _I0)),
         interpret=interpret,
     )(hi, lo, w2)
 
-    c = jnp.sum(partial, axis=0, dtype=jnp.int64)[:N_BINS]
+    c = jnp.sum(partial, axis=1, dtype=jnp.int64)
     # hist[e] = c_e - c_{e+1}; c_63 counts x >= 2^63 (none: reuse < 2^63)
     return c - jnp.concatenate([c[1:], jnp.zeros(1, jnp.int64)])
 
