@@ -90,6 +90,21 @@ class SamplerConfig:
     # dispatcher routes to the kernel only on a TPU backend either
     # way, so the flag is TPU-only in effect.)
     use_pallas_hist: bool = False
+    # Draw, dedup, and thin sample keys ON the default device with the
+    # threefry counter PRNG (sampler/draw.py) instead of numpy on the
+    # host. None = auto: ON for accelerator backends, OFF for CPU —
+    # each backend's measured best (GEMM N=1024, 3-rep medians):
+    # on a tunneled TPU v5e the host path ships 8 bytes/sample over a
+    # ~70 MB/s link with ~70 ms round trips and the device path wins
+    # >4x end-to-end; on a host core numpy PCG + np.unique beats
+    # threefry + two XLA sorts 2.3x (0.85 s vs 1.93 s). Explicit
+    # True/False overrides. Each path is deterministic in the seed;
+    # the two paths' sample SETS differ (statistically equivalent —
+    # tests/test_draw.py pins the MRC agreement), so recorded per-seed
+    # artifacts are comparable only within one path. Refs whose draw
+    # buffer exceeds draw.DEVICE_DRAW_MAX_SLOTS fall back to the host
+    # path either way.
+    device_draw: bool | None = None
 
     def num_samples(self, trips) -> int:
         import math

@@ -1,0 +1,231 @@
+"""Device-side sample drawing for the random-start sampled engine.
+
+The reference's r10 sampler draws its random start points with rand()
+on the host (c_lib/test/sampler/gemm-t4-pluss-pro-model-rs-ri-opt-r10.cpp:159-185,
+draw-until-s-unique). Round 2's engine kept host drawing (numpy PCG)
+and shipped one int64 key per sample to the device — the minimal wire
+format, but still 8 bytes/sample across a link that, when the TPU sits
+behind a network tunnel, moves ~70 MB/s with ~70 ms per round trip
+(measured; the device-side compute for the same batch is ~0.1 ms).
+At GEMM N=4096 the keys alone are 2.2 GB: the engine was >95%
+host->device transfer.
+
+This module moves the draw onto the device, so nothing crosses the
+link but a per-ref RNG key and a handful of scalars:
+
+- candidates are drawn with JAX's threefry counter PRNG — the bit
+  stream is deterministic AND backend-invariant, so a seed produces
+  the same sample set on CPU and TPU (numpy's host stream could never
+  be replayed on-device);
+- dedup is one global sort + neighbor-compare (the draw-until-unique
+  loop's set semantics, vectorized);
+- thinning to exactly s is select-by-random-priority: every candidate
+  gets an independent uint64 priority, and the s smallest priorities
+  among the unique representatives win — a uniform s-subset of the
+  uniques, like the host path's rng.choice drop-set (priority ties at
+  the threshold have probability ~2^-64 and are re-drawn);
+- triangular nests draw from the bounding box and reject out-of-bounds
+  points before dedup (same box-rejection scheme as the host path).
+
+The one scalar that must come back is the unique count U (to certify
+U >= s); the host retries with a fresh fold and a larger buffer on
+the rare shortfall — exactness never depends on a probabilistic
+margin.
+
+Buffer shapes are bucketed to multiples of the dispatch batch so the
+downstream classify kernels see ONE compiled shape per (ref, batch)
+regardless of N, and rectangular refs share a single draw kernel per
+bucket size (the triangular rejection mask needs per-nest geometry,
+so tri refs compile per nest).
+"""
+
+from __future__ import annotations
+
+import functools
+import weakref
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+# Above this many int64 buffer slots (~2.2e8 -> ~10 GB across the
+# sort/priority temporaries) the draw falls back to the host path:
+# a v5e chip has 16 GB of HBM and the global-sort dedup needs several
+# B-sized temporaries live at once.
+DEVICE_DRAW_MAX_SLOTS = 1 << 28
+
+# Rejection sentinel: strictly greater than every valid flat key.
+# plan_draw routes space_box >= 2^63 - 1 to the host path (which
+# raises the documented NotImplementedError), so valid keys are
+# always <= 2^63 - 3 < _SENT.
+_SENT = np.iinfo(np.int64).max
+
+
+def bucket_size(m: int, batch: int) -> int:
+    """Round the candidate count up to a multiple of the dispatch
+    batch (so chunk shapes are shared) with at least one batch."""
+    return max(batch, -(-m // batch) * batch)
+
+
+def plan_draw(nt, ref_idx: int, cfg, batch: int):
+    """The device-draw plan for one ref: (B, tri?, s, highs, excl,
+    space_box), or None when the ref cannot take the device path
+    (s == 0, empty tri space, a buffer beyond DEVICE_DRAW_MAX_SLOTS,
+    or a box at the int64 edge where the sentinel would alias valid
+    keys — the host path raises its documented error there). Single
+    source of truth for draw_sample_keys_device and warmup()."""
+    from .sampled import _sample_plan
+
+    highs, s, space_valid = _sample_plan(nt, ref_idx, cfg)
+    if s == 0 or space_valid == 0:
+        return None
+    tri = nt.tri and int(nt.tables.ref_levels[ref_idx]) >= 1
+    excl = 1 if cfg.exclude_last_iteration else 0
+    space_box = 1
+    for h in highs:
+        space_box *= h
+    if space_box >= _SENT:
+        return None
+    if tri:
+        # margin scales by the box/valid ratio the rejection will eat
+        m = (s + s // 8 + 64) * space_box // space_valid + 64
+    else:
+        m = s + s // 8 + 64
+    B = bucket_size(m, batch)
+    if B > DEVICE_DRAW_MAX_SLOTS:
+        return None
+    return B, tri, s, tuple(highs), excl, space_box
+
+
+def _select_exact(sk, valid_first, s, pri_key):
+    """Uniform s-subset of the unique representatives in sorted keys.
+
+    `valid_first` marks the first occurrence of each non-sentinel key.
+    Returns (chosen mask, U, n_chosen): priorities are independent
+    uint64 draws, the s smallest among representatives win; the counts
+    come back to the host to certify exactness (U >= s and
+    n_chosen == s), everything else stays on device.
+    """
+    B = sk.shape[0]
+    U = jnp.sum(valid_first.astype(jnp.int64))
+    pri = jr.bits(pri_key, (B,), dtype=jnp.uint64)
+    pri = jnp.where(valid_first, pri, jnp.uint64(np.iinfo(np.uint64).max))
+    spri = jnp.sort(pri)
+    # threshold = s-th smallest priority among representatives; s is
+    # traced so any s shares the compile
+    thr = jnp.take(spri, jnp.clip(s - 1, 0, B - 1))
+    chosen = valid_first & (pri <= thr)
+    return chosen, U, jnp.sum(chosen.astype(jnp.int64))
+
+
+@functools.lru_cache(maxsize=32)
+def _rect_draw_kernel(B: int):
+    """Shared draw kernel for rectangular refs: every ref/model/N with
+    the same bucket size reuses one compile (space and s are traced)."""
+
+    @jax.jit
+    def draw(rng_key, space, s):
+        k1, k2 = jr.split(rng_key)
+        keys = jr.randint(k1, (B,), 0, space, dtype=jnp.int64)
+        sk = jnp.sort(keys)
+        first = jnp.concatenate(
+            [jnp.ones(1, bool), sk[1:] != sk[:-1]]
+        )
+        chosen, U, n_chosen = _select_exact(sk, first, s, k2)
+        return sk, chosen, U, n_chosen
+
+    return draw
+
+
+def _build_tri_draw_kernel(nt, ref_idx: int, highs: tuple, excl: int, B: int):
+    """Box-draw + rejection for one triangular ref (per-nest geometry
+    lives in the closure, so these compile per ref)."""
+    from .sampled import decode_sample_keys
+
+    lv = int(nt.tables.ref_levels[ref_idx])
+    space_box = 1
+    for h in highs:
+        space_box *= h
+
+    @jax.jit
+    def draw(rng_key, s):
+        k1, k2 = jr.split(rng_key)
+        keys = jr.randint(k1, (B,), 0, space_box, dtype=jnp.int64)
+        cols = decode_sample_keys(keys, highs)
+        v0 = nt.nest.loops[0].start + cols[:, 0] * nt.nest.loops[0].step
+        ok = jnp.ones(B, dtype=bool)
+        for l in range(1, lv + 1):
+            ok &= cols[:, l] < (nt.nest.loops[l].trip_at(v0) - excl)
+        sk = jnp.sort(jnp.where(ok, keys, jnp.int64(_SENT)))
+        first = jnp.concatenate(
+            [jnp.ones(1, bool), sk[1:] != sk[:-1]]
+        ) & (sk < _SENT)
+        chosen, U, n_chosen = _select_exact(sk, first, s, k2)
+        return sk, chosen, U, n_chosen
+
+    return draw
+
+
+def draw_sample_keys_device(
+    nt, ref_idx: int, cfg, seed: int, batch: int
+):
+    """Exactly-s distinct uniform sample keys, drawn and thinned on the
+    default device.
+
+    Returns (keys (B,) int64 device array, chosen (B,) bool device
+    array with exactly s True entries, s, highs) — the masked form
+    feeds the masked classify kernels without ever compacting to a
+    per-ref shape. Returns None when plan_draw declines the ref (the
+    caller falls back to the host draw).
+
+    Deterministic in (cfg.seed-derived seed): threefry bits are
+    backend-invariant, so CPU tests and TPU benches see the same
+    sample sets. The [0, space) draw carries jax.random.randint's
+    modulo bias of at most space/2^64 < 2^-18 relative — orders of
+    magnitude below sampling noise (the host numpy path is unbiased;
+    the two paths are statistically, not bitwise, identical).
+    """
+    plan = plan_draw(nt, ref_idx, cfg, batch)
+    if plan is None:
+        return None
+    B, tri, s, highs, excl, space_box = plan
+
+    base = jr.key(np.uint32(seed & 0xFFFFFFFF))
+    base = jr.fold_in(base, np.uint32((seed >> 32) & 0xFFFFFFFF))
+    for attempt in range(8):
+        rng_key = jr.fold_in(base, attempt)
+        if tri:
+            kern = _get_tri_kernel(nt, ref_idx, highs, excl, B)
+            sk, chosen, U, n_chosen = kern(rng_key, jnp.int64(s))
+        else:
+            kern = _rect_draw_kernel(B)
+            sk, chosen, U, n_chosen = kern(
+                rng_key, jnp.int64(space_box), jnp.int64(s)
+            )
+        if int(U) >= s and int(n_chosen) == s:
+            return sk, chosen, s, highs
+        # shortfall (not enough uniques in the buffer) or a 2^-64
+        # priority tie: grow the buffer and redraw from a fresh fold
+        B = bucket_size(B + B // 2, batch)
+        if B > DEVICE_DRAW_MAX_SLOTS:
+            return None
+    raise RuntimeError(
+        f"device draw failed to reach {s} unique samples in 8 attempts "
+        f"(ref {nt.tables.ref_names[ref_idx]}; last buffer {B})"
+    )
+
+
+# tri kernels cached per NestTrace via weak keys: an entry dies with
+# its trace (no unbounded growth, and no stale kernel can survive an
+# lru eviction of _program_kernels and serve another nest's geometry
+# through id() reuse).
+_TRI_KERNELS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _get_tri_kernel(nt, ref_idx, highs, excl, B):
+    per_nt = _TRI_KERNELS.setdefault(nt, {})
+    key = (ref_idx, highs, excl, B)
+    if key not in per_nt:
+        per_nt[key] = _build_tri_draw_kernel(nt, ref_idx, highs, excl, B)
+    return per_nt[key]

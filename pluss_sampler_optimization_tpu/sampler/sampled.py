@@ -82,15 +82,17 @@ class SampledRefResult:
     n_samples: int
 
 
-def _sample_highs(nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig):
-    """(bounding-box highs, target sample count) for one tracked ref.
+def _sample_plan(nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig):
+    """(bounding-box highs, target sample count, |valid space|) for one
+    tracked ref — the single source of truth for both draw paths.
 
     Triangular nests draw from the rectangular bounding box and reject
-    points outside the per-v0 bounds (draw_sample_keys); the target
-    count generalizes the generated-code expression to
+    points outside the per-v0 bounds (draw_sample_keys / draw.py); the
+    target count generalizes the generated-code expression to
     ceil(ratio^depth * |valid drawable space|) — the same density over
     the space that actually exists (rectangular nests keep the exact
-    `ceil(prod(ratio*trip))` form via cfg.num_samples).
+    `ceil(prod(ratio*trip))` form via cfg.num_samples, and their valid
+    space IS the box).
     """
     lv = int(nest_trace.tables.ref_levels[ref_idx])
     excl = 1 if cfg.exclude_last_iteration else 0
@@ -111,16 +113,25 @@ def _sample_highs(nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig):
             )
         space = int(cnt.sum())
         if space == 0:
-            return highs, 0
+            return highs, 0, 0
         s = max(1, min(
             int(math.ceil((cfg.ratio ** (lv + 1)) * space)), space
         ))
-        return highs, s
+        return highs, s, space
     trips = [nest_trace.nest.loops[l].trip for l in range(lv + 1)]
     highs = [
         max(1, t - 1 if cfg.exclude_last_iteration else t) for t in trips
     ]
-    return highs, cfg.num_samples(tuple(trips))
+    space = 1
+    for h in highs:
+        space *= h
+    return highs, cfg.num_samples(tuple(trips)), space
+
+
+def _sample_highs(nest_trace: NestTrace, ref_idx: int, cfg: SamplerConfig):
+    """(bounding-box highs, target sample count); see _sample_plan."""
+    highs, s, _ = _sample_plan(nest_trace, ref_idx, cfg)
+    return highs, s
 
 
 def _tri_valid_keys(nest_trace: NestTrace, ref_idx: int, keys, highs, excl):
@@ -317,6 +328,30 @@ def _build_ref_kernel(nt: NestTrace, ref_idx: int):
     return kernel
 
 
+def _build_ref_kernel_masked(nt: NestTrace, ref_idx: int):
+    """Masked twin of _build_ref_kernel for device-drawn samples.
+
+    Device-side drawing (sampler/draw.py) produces a full candidate
+    buffer plus a boolean selection mask instead of a compacted
+    prefix, so downstream shapes stay one-per-batch across every ref
+    and N; this kernel consumes (keys chunk, mask chunk) directly —
+    the buffer never round-trips through the host.
+    """
+    check_packed_ratios(nt)
+
+    @functools.partial(jax.jit, static_argnames=("highs", "capacity"))
+    def kernel(sample_keys, mask, highs: tuple, capacity: int):
+        samples = decode_sample_keys(sample_keys, highs)
+        packed, _, _, found = classify_samples(nt, ref_idx, samples)
+        keys, counts, n_unique = fixed_k_unique(
+            packed, found & mask, capacity
+        )
+        cold = jnp.sum((~found & mask).astype(jnp.int64))
+        return keys, counts, n_unique, cold
+
+    return kernel
+
+
 def _sample_geometry(nt: NestTrace, ref_idx: int, samples):
     """Sample tuples -> (tid, p0, line, m) in the thread-local trace."""
     t = nt.tables
@@ -425,7 +460,10 @@ def _program_kernels(program: Program, machine: MachineConfig):
                 "or stream engine"
             )
         for ri in range(nt.tables.n_refs):
-            kernels.append((k, ri, _build_ref_kernel(nt, ri)))
+            kernels.append(
+                (k, ri, _build_ref_kernel(nt, ri),
+                 _build_ref_kernel_masked(nt, ri))
+            )
     return trace, kernels
 
 
@@ -448,11 +486,38 @@ def warmup(
     if batch is None:
         batch = default_batch()
     trace, kernels = _program_kernels(program, machine)
-    for k, ri, kernel in kernels:
+    drawn_buckets: set = set()
+    for k, ri, kernel, kernel_m in kernels:
         nt = trace.nests[k]
         highs, s = _sample_highs(nt, ri, cfg)
         if s == 0:  # no drawable points (degenerate triangular ref)
             continue
+        if _use_device_draw(cfg):
+            # compile the masked kernel at the shared (batch,) shape
+            # and the draw kernel at this ref's bucket size (rect
+            # buckets are shared across refs, so the set dedups; tri
+            # kernels are per-ref closures)
+            from .draw import _get_tri_kernel, _rect_draw_kernel, plan_draw
+
+            plan = plan_draw(nt, ri, cfg, batch)
+            if plan is not None:
+                B, tri, s_plan, highs_t, excl, space_box = plan
+                if tri:
+                    jax.block_until_ready(_get_tri_kernel(
+                        nt, ri, highs_t, excl, B
+                    )(jax.random.key(0), jnp.int64(s_plan)))
+                elif B not in drawn_buckets:
+                    drawn_buckets.add(B)
+                    jax.block_until_ready(_rect_draw_kernel(B)(
+                        jax.random.key(0), jnp.int64(space_box),
+                        jnp.int64(s_plan),
+                    ))
+                dummy = jnp.zeros(batch, dtype=jnp.int64)
+                jax.block_until_ready(kernel_m(
+                    dummy, dummy < 0, tuple(highs), capacity
+                ))
+                continue
+            # over-budget refs take the host path below
         keys = np.zeros(min(s, batch), dtype=np.int64)
         chunk, n_valid = pad_keys(
             keys, 1, total=batch if s > batch else None
@@ -467,21 +532,40 @@ def warmup(
 # version is folded into every checkpoint tag, so stale files from an
 # older engine are recomputed instead of silently reused — the tag
 # otherwise only captures inputs. v3: flat-space key drawing changed
-# the per-seed sample sets.
-_CHECKPOINT_SCHEMA = 3
+# the per-seed sample sets. v4: device-side threefry drawing
+# (cfg.device_draw) changed them again.
+_CHECKPOINT_SCHEMA = 4
 
 
-def _checkpoint_tagger(program, machine, cfg):
+def _use_device_draw(cfg) -> bool:
+    """Resolve cfg.device_draw (None = auto): device-side drawing on
+    accelerator backends, host numpy on CPU — each backend's measured
+    best (see SamplerConfig.device_draw)."""
+    if cfg.device_draw is None:
+        return jax.default_backend() != "cpu"
+    return cfg.device_draw
+
+
+def _checkpoint_tagger(program, machine, cfg, batch):
     """(idx, name) -> checkpoint tag; the program-structure hash (loops,
     refs, thresholds — same-named programs can differ structurally,
-    e.g. gemm's share_threshold_variant) is computed once per run."""
+    e.g. gemm's share_threshold_variant) is computed once per run.
+
+    The device draw's sample stream depends on the buffer bucketing
+    (B = bucket_size(m, batch)), so the batch joins the tag on that
+    path — a resume under a different batch (or another backend's
+    default_batch) must recompute, not mix two streams under one
+    seed. The host numpy stream is batch-independent and keeps its
+    batch-free tag."""
     import hashlib
 
     struct = hashlib.sha256(repr(program).encode()).hexdigest()[:16]
+    dev = _use_device_draw(cfg)
     prefix = (
         f"v{_CHECKPOINT_SCHEMA}|{program.name}/{struct}|{machine.thread_num},"
         f"{machine.chunk_size},{machine.ds},{machine.cls}|{cfg.ratio},"
-        f"{cfg.seed},{cfg.exclude_last_iteration}"
+        f"{cfg.seed},{cfg.exclude_last_iteration},{dev}"
+        + (f",b{batch}" if dev else "")
     )
     return lambda idx, name: f"{prefix}|{idx}|{name}"
 
@@ -549,9 +633,9 @@ def sampled_outputs(
     trace, kernels = _program_kernels(program, machine)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
-        tag_of = _checkpoint_tagger(program, machine, cfg)
+        tag_of = _checkpoint_tagger(program, machine, cfg, batch)
     results = []
-    for idx, (k, ri, kernel) in enumerate(kernels):
+    for idx, (k, ri, kernel, kernel_m) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
         ck_path = ck_tag = None
@@ -562,10 +646,28 @@ def sampled_outputs(
             if prior is not None:
                 results.append(prior)
                 continue
-        keys_all, highs = draw_sample_keys(
-            nt, ri, cfg, seed=cfg.seed * 1000003 + idx
-        )
-        n_samples = len(keys_all)
+        # Device path first: draw + dedup + thin on the device, feed
+        # the masked kernel buffer chunks that never touch the host
+        # (sampler/draw.py — the host<->device link can be a network
+        # tunnel at ~70 MB/s, while the device-side compute for a
+        # batch is ~0.1 ms). Falls back to the host numpy draw when
+        # disabled or when the ref's buffer would exceed the device
+        # budget.
+        drawn = None
+        if _use_device_draw(cfg):
+            from .draw import draw_sample_keys_device
+
+            drawn = draw_sample_keys_device(
+                nt, ri, cfg, seed=cfg.seed * 1000003 + idx, batch=batch
+            )
+        if drawn is None:
+            # device drawing disabled, over the device budget, or s==0
+            keys_all, highs = draw_sample_keys(
+                nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+            )
+            n_samples = len(keys_all)
+        else:
+            dev_keys, dev_mask, n_samples, highs = drawn
         noshare: dict[int, float] = {}
         share: dict[int, dict[int, float]] = {}
         cold = 0.0
@@ -574,7 +676,7 @@ def sampled_outputs(
 
         def drain(entry):
             nonlocal cold, cap
-            out, chunk, n_valid, dispatch_cap = entry
+            out, redo, dispatch_cap = entry
             keys, counts, n_unique, c = jax.device_get(out)
             while int(n_unique) > dispatch_cap:
                 # rare: more distinct (reuse, class) pairs than slots —
@@ -582,23 +684,37 @@ def sampled_outputs(
                 dispatch_cap = max(dispatch_cap * 4, int(n_unique))
                 cap = max(cap, dispatch_cap)
                 keys, counts, n_unique, c = jax.device_get(
-                    kernel(chunk, n_valid, tuple(highs), dispatch_cap)
+                    redo(dispatch_cap)
                 )
             cold += float(c)
             decode_pairs(keys, counts, noshare, share)
 
-        for s0 in range(0, n_samples, batch):
-            chunk, n_valid = pad_keys(
-                keys_all[s0 : s0 + batch], 1,
-                total=batch if n_samples > batch else None,
-            )
-            chunk = jnp.asarray(chunk)
-            pending.append(
-                (kernel(chunk, n_valid, tuple(highs), cap), chunk,
-                 n_valid, cap)
-            )
-            if len(pending) >= 4:
-                drain(pending.pop(0))
+        if drawn is not None:
+            B = dev_keys.shape[0]
+            for s0 in range(0, B, batch):
+                kc = jax.lax.slice(dev_keys, (s0,), (s0 + batch,))
+                mc = jax.lax.slice(dev_mask, (s0,), (s0 + batch,))
+
+                def redo(c2, kc=kc, mc=mc):
+                    return kernel_m(kc, mc, tuple(highs), c2)
+
+                pending.append((redo(cap), redo, cap))
+                if len(pending) >= 4:
+                    drain(pending.pop(0))
+        else:
+            for s0 in range(0, n_samples, batch):
+                chunk, n_valid = pad_keys(
+                    keys_all[s0 : s0 + batch], 1,
+                    total=batch if n_samples > batch else None,
+                )
+                chunk = jnp.asarray(chunk)
+
+                def redo(c2, chunk=chunk, n_valid=n_valid):
+                    return kernel(chunk, n_valid, tuple(highs), c2)
+
+                pending.append((redo(cap), redo, cap))
+                if len(pending) >= 4:
+                    drain(pending.pop(0))
         for entry in pending:
             drain(entry)
         result = SampledRefResult(
@@ -633,7 +749,7 @@ def results_from_samples(
     trace, kernels = _program_kernels(program, machine)
     seen: set[str] = set()
     results = []
-    for k, ri, _ in kernels:
+    for k, ri, _, _ in kernels:
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
         if name not in samples_by_ref:

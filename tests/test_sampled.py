@@ -296,17 +296,23 @@ def test_sampled_checkpoint_resume(tmp_path):
     files = sorted((tmp_path / "ck").glob("ref_*.json"))
     assert len(files) == len(first) == 6
 
-    # resume must not re-draw: poison draw_sample_keys to prove it
+    # resume must not re-draw: poison BOTH draw paths (host numpy and
+    # device threefry — the default) to prove neither is re-invoked
+    from pluss_sampler_optimization_tpu.sampler import draw as D
     from pluss_sampler_optimization_tpu.sampler import sampled as S
 
+    def _boom(*a, **k):
+        raise AssertionError("resume must not redraw completed refs")
+
     orig = S.draw_sample_keys
-    S.draw_sample_keys = lambda *a, **k: (_ for _ in ()).throw(
-        AssertionError("resume must not redraw completed refs")
-    )
+    orig_dev = D.draw_sample_keys_device
+    S.draw_sample_keys = _boom
+    D.draw_sample_keys_device = _boom
     try:
         _, resumed = run_sampled(prog, machine, cfg, checkpoint_dir=ck)
     finally:
         S.draw_sample_keys = orig
+        D.draw_sample_keys_device = orig_dev
     for a, b, c in zip(fresh, first, resumed):
         assert a.name == b.name == c.name
         assert a.noshare == b.noshare == c.noshare

@@ -1,0 +1,104 @@
+"""Micro-profile the sampled engine's per-batch stages on the live device.
+
+Splits one ref's dispatch into its three stages — key decode, classify
+(closed-form next-use), and the fixed_k_unique reduction — and times
+each at the default accelerator batch size, so "the engine is slow on
+X" resolves to the stage that actually is. Run on the bench host:
+
+    JAX_PLATFORMS=tpu python tools/profile_tpu_stages.py [--n 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def med_time(fn, *args, reps=5):
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--model", default="gemm")
+    ap.add_argument("--ref", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    print("device:", jax.devices()[0])
+
+    from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+    from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+    from pluss_sampler_optimization_tpu.models import REGISTRY
+    from pluss_sampler_optimization_tpu.ops.histogram import fixed_k_unique
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        _best_sink,
+        _sample_geometry,
+        _sample_highs,
+        classify_samples,
+        decode_sample_keys,
+        default_batch,
+    )
+
+    machine = MachineConfig()
+    prog = REGISTRY[args.model](args.n)
+    trace = ProgramTrace(prog, machine)
+    nt = trace.nests[0]
+    cfg = SamplerConfig(ratio=0.1, seed=0)
+    highs, _ = _sample_highs(nt, args.ref, cfg)
+    batch = default_batch()
+    rng = np.random.default_rng(0)
+    space = int(np.prod(highs))
+    keys = jnp.asarray(rng.integers(0, space, size=batch, dtype=np.int64))
+    print(f"batch={batch} highs={highs}")
+
+    dec = jax.jit(lambda k: decode_sample_keys(k, tuple(highs)))
+    t = med_time(dec, keys)
+    print(f"decode:          {t * 1e3:9.2f} ms")
+
+    samples = dec(keys)
+
+    geo = jax.jit(lambda s: _sample_geometry(nt, args.ref, s))
+    t = med_time(geo, samples)
+    print(f"geometry:        {t * 1e3:9.2f} ms")
+
+    tid, p0, line, m0 = geo(samples)
+
+    sink = jax.jit(lambda a, b, c, d: _best_sink(nt, args.ref, a, b, c, d))
+    t = med_time(sink, tid, p0, line, m0)
+    print(f"best_sink:       {t * 1e3:9.2f} ms")
+
+    cls = jax.jit(lambda s: classify_samples(nt, args.ref, s))
+    t = med_time(cls, samples)
+    print(f"classify (all):  {t * 1e3:9.2f} ms")
+
+    packed, _, _, found = cls(samples)
+    w = jnp.arange(batch, dtype=jnp.int64) < (batch - 7)
+
+    uniq = jax.jit(
+        lambda v, m: fixed_k_unique(v, m, 64), static_argnums=()
+    )
+    t = med_time(uniq, packed, found & w)
+    print(f"fixed_k_unique:  {t * 1e3:9.2f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
