@@ -42,7 +42,6 @@ so tri refs compile per nest).
 from __future__ import annotations
 
 import functools
-import weakref
 
 import jax
 import jax.numpy as jnp
@@ -216,15 +215,14 @@ def draw_sample_keys_device(
     )
 
 
-# tri kernels cached per NestTrace via weak keys: an entry dies with
-# its trace (no unbounded growth, and no stale kernel can survive an
-# lru eviction of _program_kernels and serve another nest's geometry
-# through id() reuse).
-_TRI_KERNELS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-
-
 def _get_tri_kernel(nt, ref_idx, highs, excl, B):
-    per_nt = _TRI_KERNELS.setdefault(nt, {})
+    """Tri draw kernels cached ON the NestTrace: the kernel closure
+    references nt (trip_at etc. in the jitted body), so any external
+    registry keyed by nt — weak or strong — would keep the trace alive
+    through its own values; an attribute cache gives the kernels
+    exactly the trace's lifetime and cannot serve another nest's
+    geometry after an id() reuse."""
+    per_nt = nt.__dict__.setdefault("_tri_draw_kernels", {})
     key = (ref_idx, highs, excl, B)
     if key not in per_nt:
         per_nt[key] = _build_tri_draw_kernel(nt, ref_idx, highs, excl, B)

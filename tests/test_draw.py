@@ -77,7 +77,9 @@ def test_over_budget_falls_back_to_host(monkeypatch):
     numpy draw inside sampled_outputs and still produces results."""
     monkeypatch.setattr(D, "DEVICE_DRAW_MAX_SLOTS", 1 << 10)
     machine = MACHINE
-    cfg = SamplerConfig(ratio=0.3, seed=2)
+    # device_draw=True explicitly: the None default resolves to the
+    # host path on CPU runners and would skip the routing under test
+    cfg = SamplerConfig(ratio=0.3, seed=2, device_draw=True)
     assert D.plan_draw(
         ProgramTrace(gemm(64), machine).nests[0], 0, cfg, 1 << 14
     ) is None
