@@ -15,8 +15,13 @@ the reduction happens on-device:
 - cold-sample counts psum'd to a scalar.
 
 The result is bit-identical to sampler/sampled.py on any mesh size
-(same host-side sample draw, same per-sample math; the unique merge is
-exact), which is the sharded path's correctness test.
+under either draw mode — the host numpy stream or the device threefry
+stream (sampler/draw.py; same seed + batch bucketing => same sample
+set, and the unique merge is exact) — which is the sharded path's
+correctness test. Device drawing engages on single-process meshes
+whose size divides the batch; multi-host runs keep the host stream
+(every process replays it deterministically and ships only its own
+rows).
 
 Dense engine: the jitted per-tid kernel (sampler/dense.py) is already
 vmapped over simulated threads; `run_dense_sharded` lays that batch axis
@@ -40,10 +45,12 @@ from ..ir import Program
 from ..ops.histogram import N_EXP_BINS, exp_hist, fixed_k_unique
 from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
+from ..sampler.draw import draw_sample_keys_device
 from ..sampler.sampled import (
     default_batch,
     DEFAULT_CAPACITY,
     SampledRefResult,
+    _use_device_draw,
     check_packed_ratios,
     classify_samples,
     decode_pairs,
@@ -57,9 +64,16 @@ from .mesh import build_mesh
 
 def _build_sharded_ref_kernel(
     nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int,
-    use_pallas_hist: bool,
+    use_pallas_hist: bool, masked: bool = False,
 ):
-    """jit(shard_map) kernel: sharded samples -> reduced histograms."""
+    """jit(shard_map) kernel: sharded samples -> reduced histograms.
+
+    The second operand is either a replicated valid-prefix count
+    (masked=False, the host draw's padded-chunk form) or a sharded
+    per-slot selection mask (masked=True, the device draw's buffer
+    form, sampler/draw.py); everything downstream of the mask is one
+    body, so the two draw modes cannot diverge in reduction semantics.
+    """
     axis = mesh.axis_names[0]
     check_packed_ratios(nt)
 
@@ -68,14 +82,17 @@ def _build_sharded_ref_kernel(
     else:
         _hist_fn = exp_hist
 
-    def local_fn(sample_keys, n_valid, highs):
+    def local_fn(sample_keys, valid, highs):
         # int64 mixed-radix keys on the wire (8 bytes/sample); decode
         # and the padding weight mask both happen device-side
         samples = decode_sample_keys(sample_keys, highs)
         packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
-        local_b = sample_keys.shape[0]
-        base = jax.lax.axis_index(axis).astype(jnp.int64) * local_b
-        w = base + jnp.arange(local_b, dtype=jnp.int64) < n_valid
+        if masked:
+            w = valid
+        else:
+            local_b = sample_keys.shape[0]
+            base = jax.lax.axis_index(axis).astype(jnp.int64) * local_b
+            w = base + jnp.arange(local_b, dtype=jnp.int64) < valid
         # scalable output: dense pow2 noshare histogram, psum over ICI
         nosh_hist = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
         nosh_hist = jax.lax.psum(nosh_hist, axis)
@@ -91,16 +108,16 @@ def _build_sharded_ref_kernel(
         n_u = jax.lax.all_gather(n_unique, axis)  # (n_dev,)
         return nosh_hist, cold, keys, counts, n_u
 
-    def entry(sample_keys, n_valid, highs: tuple):
+    def entry(sample_keys, valid, highs: tuple):
         return jax.shard_map(
             functools.partial(local_fn, highs=highs),
             mesh=mesh,
-            in_specs=(P(axis), P()),
+            in_specs=(P(axis), P(axis) if masked else P()),
             out_specs=(P(), P(), P(), P(), P()),
             # all_gather outputs ARE replicated, but the static
             # varying-axes check cannot infer that
             check_vma=False,
-        )(sample_keys, n_valid)
+        )(sample_keys, valid)
 
     return jax.jit(entry, static_argnames=("highs",))
 
@@ -112,6 +129,7 @@ def _sharded_program_kernels(
     mesh: jax.sharding.Mesh,
     capacity: int,
     use_pallas_hist: bool,
+    masked: bool = False,
 ):
     trace = ProgramTrace(program, machine)
     kernels = []
@@ -126,7 +144,7 @@ def _sharded_program_kernels(
             kernels.append(
                 [k, ri,
                  _build_sharded_ref_kernel(
-                     nt, ri, mesh, capacity, use_pallas_hist
+                     nt, ri, mesh, capacity, use_pallas_hist, masked
                  ),
                  capacity]  # capacity travels with the kernel: a
             )                # regrown kernel returns wider arrays
@@ -151,60 +169,135 @@ def sampled_outputs_sharded(
     trace, kernels = _sharded_program_kernels(
         program, machine, mesh, capacity, cfg.use_pallas_hist
     )
+    n_proc = jax.process_count()
+    in_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    # Device drawing on the mesh: single-process only (each process
+    # would need its shard of a buffer drawn on one device; the host
+    # stream stays the multi-host path — every process replays it
+    # deterministically) and batch must split evenly over the mesh so
+    # the buffer's batch-sized chunks reshard without padding. The
+    # realistic single-host TPU topologies (v4-8, v5e-8: power-of-2
+    # meshes dividing the 2^20 batch) and the test suite's virtual CPU
+    # mesh all qualify. An EXPLICIT device_draw=True with a
+    # non-dividing mesh raises rather than silently sampling from the
+    # other stream — the bit-identity-with-run_sampled contract is the
+    # sharded path's correctness anchor; the auto default (None)
+    # resolves to the host stream in that case.
+    use_dev_draw = _use_device_draw(cfg) and n_proc == 1
+    if use_dev_draw and batch % n_dev != 0:
+        if cfg.device_draw:
+            raise ValueError(
+                f"device_draw=True needs a mesh size dividing the "
+                f"batch ({batch} % {n_dev} != 0): the device buffer "
+                "cannot reshard evenly, and falling back would sample "
+                "a different stream than run_sampled. Use a dividing "
+                "mesh size or device_draw=None/False."
+            )
+        use_dev_draw = False
+    masked_kernels = None
+    if use_dev_draw:
+        # lru-cached like the host-form kernels (masked=True keys a
+        # separate entry), so repeat calls and capacity regrows are
+        # paid once
+        _, masked_kernels = _sharded_program_kernels(
+            program, machine, mesh, capacity, cfg.use_pallas_hist,
+            masked=True,
+        )
     results = []
     dense_noshare = []
     for idx, (k, ri, kernel, cap) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
-        # key form until dispatch: a large run holds 1/3 the memory
-        # (see draw_sample_keys)
-        keys_all, highs = draw_sample_keys(
-            nt, ri, cfg, seed=cfg.seed * 1000003 + idx
-        )
-        n_samples = len(keys_all)
+        drawn = None
+        if use_dev_draw:
+            drawn = draw_sample_keys_device(
+                nt, ri, cfg, seed=cfg.seed * 1000003 + idx, batch=batch
+            )
+        if drawn is None:
+            # key form until dispatch: a large run holds 1/3 the
+            # memory (see draw_sample_keys)
+            keys_all, highs = draw_sample_keys(
+                nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+            )
+            n_samples = len(keys_all)
+        else:
+            dev_keys, dev_mask, n_samples, highs = drawn
         noshare: dict[int, float] = {}
         share: dict[int, dict[int, float]] = {}
         cold = 0.0
         dense = np.zeros(N_EXP_BINS, dtype=np.int64)
         step = max(n_dev, (batch // n_dev) * n_dev)
-        n_proc = jax.process_count()
-        in_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-        for s0 in range(0, n_samples, step):
-            chunk, n_valid = pad_keys(
-                keys_all[s0 : s0 + step], n_dev,
-                total=step if n_samples > step else None,
-            )
-            # every process draws the same batch (deterministic host
-            # RNG) and ships only the rows its own devices hold; jax
-            # assembles the global sharded array. One path for any
-            # process count — single-process degenerates to the full
-            # chunk, already pre-sharded for the kernel.
-            rows = len(chunk) // n_proc
-            pid = jax.process_index()
-            cj = jax.make_array_from_process_local_data(
-                in_sharding, chunk[pid * rows : (pid + 1) * rows],
-                chunk.shape,
-            )
+
+        def dispatch(holder, run_kernel, rebuild):
+            """One chunk through holder's trailing [kernel, capacity]
+            entries (holder is mutated IN PLACE — either the lru-cached
+            [k, ri, kernel, cap] row or a masked_kernels [kernel, cap]
+            pair — so a capacity regrow is retained and paid once, not
+            on every later chunk/call); mirrors sampler/sampled.py's
+            drain loop."""
+            nonlocal cold, dense
             while True:
+                kern, c2 = holder[-2], holder[-1]
                 nh, c, keys, counts, n_unique = jax.device_get(
-                    kernel(cj, n_valid, tuple(highs))
+                    run_kernel(kern)
                 )
-                if int(n_unique.max(initial=0)) <= cap:
+                if int(n_unique.max(initial=0)) <= c2:
                     break
-                # rare: more distinct pairs than per-device slots —
-                # rebuild this ref's kernel with a larger capacity
-                # rather than abort (mirrors sampler/sampled.py), and
-                # retain it in the cached kernel list so the recovery
-                # is paid once, not on every later call
-                cap = max(cap * 4, int(n_unique.max(initial=0)))
-                kernel = _build_sharded_ref_kernel(
-                    nt, ri, mesh, cap, cfg.use_pallas_hist
-                )
-                kernels[idx][2:] = [kernel, cap]
+                holder[-1] = max(c2 * 4, int(n_unique.max(initial=0)))
+                holder[-2] = rebuild(holder[-1])
             dense += nh
             cold += float(c)
             for d in range(n_dev):
                 decode_pairs(keys[d], counts[d], noshare, share)
+
+        if drawn is not None:
+            B = dev_keys.shape[0]
+            for s0 in range(0, B, batch):
+                kc = jax.device_put(
+                    jax.lax.slice(dev_keys, (s0,), (s0 + batch,)),
+                    in_sharding,
+                )
+                mc = jax.device_put(
+                    jax.lax.slice(dev_mask, (s0,), (s0 + batch,)),
+                    in_sharding,
+                )
+                dispatch(
+                    masked_kernels[idx],
+                    lambda kern, kc=kc, mc=mc: kern(
+                        kc, mc, tuple(highs)
+                    ),
+                    lambda c2, nt=nt, ri=ri: _build_sharded_ref_kernel(
+                        nt, ri, mesh, c2, cfg.use_pallas_hist,
+                        masked=True,
+                    ),
+                )
+        else:
+            for s0 in range(0, n_samples, step):
+                chunk, n_valid = pad_keys(
+                    keys_all[s0 : s0 + step], n_dev,
+                    total=step if n_samples > step else None,
+                )
+                # every process draws the same batch (deterministic
+                # host RNG) and ships only the rows its own devices
+                # hold; jax assembles the global sharded array. One
+                # path for any process count — single-process
+                # degenerates to the full chunk, already pre-sharded
+                # for the kernel.
+                rows = len(chunk) // n_proc
+                pid = jax.process_index()
+                cj = jax.make_array_from_process_local_data(
+                    in_sharding, chunk[pid * rows : (pid + 1) * rows],
+                    chunk.shape,
+                )
+                dispatch(
+                    kernels[idx],
+                    lambda kern, cj=cj, n_valid=n_valid: kern(
+                        cj, n_valid, tuple(highs)
+                    ),
+                    lambda c2, nt=nt, ri=ri: _build_sharded_ref_kernel(
+                        nt, ri, mesh, c2, cfg.use_pallas_hist
+                    ),
+                )
         results.append(
             SampledRefResult(
                 name=name, noshare=noshare, share=share, cold=cold,
@@ -224,7 +317,10 @@ def run_sampled_sharded(
     **kw,
 ) -> tuple[PRIState, list[SampledRefResult]]:
     """Sharded engine -> PRIState; bit-identical to sampler/sampled.py's
-    run_sampled on any mesh size (same draw, exact merges)."""
+    run_sampled at any accepted mesh size (same draw stream — host or
+    device per _use_device_draw — and exact merges; an explicit
+    device_draw=True with a mesh size not dividing the batch raises
+    instead of silently switching streams)."""
     cfg = cfg or SamplerConfig()
     results, _ = sampled_outputs_sharded(program, machine, cfg, mesh, **kw)
     return fold_results(results, machine.thread_num, v2), results

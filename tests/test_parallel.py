@@ -65,6 +65,35 @@ def test_sampled_sharded_multinest(eight=8):
     _states_equal(state_ref, state_sh)
 
 
+def test_sampled_sharded_device_draw_nondividing_mesh_raises():
+    """Explicit device_draw=True with a mesh size that does not divide
+    the batch must raise, not silently sample the host stream (which
+    would break bit-identity with run_sampled)."""
+    cfg = SamplerConfig(ratio=0.25, seed=3, device_draw=True)
+    with pytest.raises(ValueError, match="dividing the batch"):
+        run_sampled_sharded(gemm(16), MACHINE, cfg, build_mesh(3))
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_sampled_sharded_device_draw_matches_unsharded(n_dev):
+    """Device-drawn samples through the mesh: same threefry stream as
+    the single-device device path (same seed + batch bucketing), exact
+    merges — bit-identical across mesh sizes."""
+    prog = gemm(16)
+    cfg = SamplerConfig(ratio=0.25, seed=3, device_draw=True)
+    state_ref, results_ref = run_sampled(prog, MACHINE, cfg)
+    state_sh, results_sh = run_sampled_sharded(
+        prog, MACHINE, cfg, build_mesh(n_dev)
+    )
+    _states_equal(state_ref, state_sh)
+    for ra, rb in zip(results_ref, results_sh):
+        assert ra.name == rb.name
+        assert ra.noshare == rb.noshare
+        assert ra.share == rb.share
+        assert ra.cold == rb.cold
+        assert ra.n_samples == rb.n_samples
+
+
 def test_dense_psum_histogram_matches_exact_pairs():
     """The psum'd dense noshare histogram must agree with the exact
     sparse pairs after pow2 binning."""
