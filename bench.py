@@ -158,7 +158,7 @@ def guarded_backend_init(
     init_fn, timeout_s: float, on_timeout=None, probe_was_cached=True
 ):
     """Run the first backend touches (device claim AND first compile)
-    under a watchdog bounded by the remaining --device-timeout budget.
+    under a watchdog bounded by the --warmup-timeout budget.
 
     Two ways the probe can pass while the main process still hangs:
     a cached probe marker (< _PROBE_TTL_S old) skips the subprocess
@@ -166,7 +166,7 @@ def guarded_backend_init(
     live probe's jit succeeded and the tunnel/compile service died in
     the seconds between probe exit and the main process's own init.
     Either way the main process would block with no bound — exactly
-    the failure mode --device-timeout exists to prevent. The watchdog
+    the failure mode the watchdog exists to prevent. The watchdog
     cannot interrupt a call stuck inside a PJRT plugin's claim loop
     (Python threads are not killable), so the default timeout action
     deletes the (possibly stale) marker and re-execs this process with
@@ -309,12 +309,23 @@ def main() -> int:
                     "serial run is infeasible, e.g. GEMM N=8192 at "
                     "~19h of single-core time)")
     ap.add_argument("--device-timeout", type=float, default=240.0,
-                    help="accelerator budget in seconds, shared by the "
-                    "subprocess probe and the main process's "
-                    "init+first-compile watchdog (the watchdog gets "
-                    "what the probe didn't spend, floored at 30s); "
-                    "on timeout the bench falls back to CPU "
-                    "(0 = trust the backend, no probe, no watchdog)")
+                    help="accelerator PROBE budget in seconds; a dead "
+                    "tunnel is declared within this bound and the "
+                    "bench falls back to CPU (0 = trust the backend, "
+                    "no probe, no watchdog)")
+    ap.add_argument("--warmup-timeout", type=float, default=1800.0,
+                    help="separate watchdog for init+warm-up AFTER a "
+                    "probe pass: the chip is known alive, but kernel "
+                    "compiles through the remote AOT helper run "
+                    "~1-1.5 min each (measured 2026-07-31, BASELINE.md "
+                    "on-device section) so a cold cache legitimately "
+                    "needs ~10-15 min — under the old shared budget a "
+                    "reachable TPU with a cold cache was indistinguish"
+                    "able from a hang and fell back to CPU. A warm "
+                    "cache passes in seconds; a genuine mid-warm-up "
+                    "hang (round 2 saw a compile service die 25 min "
+                    "in) is still bounded by this flag "
+                    "(0 = no warm-up watchdog)")
     ap.add_argument("--accel-hang-fallback", choices=["cached", "live"],
                     default=None, help=argparse.SUPPRESS)  # internal:
     # set by the guarded_backend_init re-exec when the probe passed
@@ -335,7 +346,7 @@ def main() -> int:
         )
         probe_evidence = [{
             "accel_hang": f"{how}; backend init/first compile then "
-            "hung past the --device-timeout budget; marker deleted "
+            "hung past the --warmup-timeout budget; marker deleted "
             "and process re-executed on the CPU backend"
         }]
     elif args.device_timeout > 0:
@@ -407,9 +418,10 @@ def main() -> int:
     # kernel at the run's batch shapes. Both can hang on a half-dead
     # tunnel even after a probe pass (a compile service once failed 25
     # minutes into warm-up), so on the accelerator path both run under
-    # one watchdog holding the budget the probe didn't spend (floored
-    # at 30s so a slow-but-passing probe still leaves the init a
-    # fighting chance; worst-case total is device_timeout + 30s).
+    # a watchdog with its own --warmup-timeout budget: a cold compile
+    # cache needs ~10-15 min of legitimately slow remote compiles,
+    # which the probe budget must not conflate with a hang (0 =
+    # disable the watchdog, symmetric with --device-timeout 0).
     stamps: dict = {}
     t0 = time.perf_counter()
 
@@ -423,13 +435,14 @@ def main() -> int:
             timed_engine_run()
         stamps["warmup_s"] = time.perf_counter() - t1
 
-    if not device_fallback and args.device_timeout > 0:
-        probe_spent = sum(
-            e.get("seconds", 0.0) for e in probe_evidence
-        )
+    if (
+        not device_fallback
+        and args.device_timeout > 0
+        and args.warmup_timeout > 0
+    ):
         guarded_backend_init(
             first_touch,
-            max(30.0, args.device_timeout - probe_spent),
+            args.warmup_timeout,
             probe_was_cached=probe_was_cached,
         )
     else:
