@@ -91,6 +91,8 @@ def _run_engine(engine: str, program, machine, args):
         kw = {}
         if args.pallas_hist is not None:  # None = keep config default
             kw["use_pallas_hist"] = args.pallas_hist
+        if args.device_draw is not None:  # None = auto per backend
+            kw["device_draw"] = args.device_draw
         cfg = SamplerConfig(ratio=args.ratio, seed=args.seed, **kw)
         v2 = args.runtime == "v2"
         if engine == "sampled":
@@ -151,9 +153,18 @@ def main(argv=None) -> int:
                     action=argparse.BooleanOptionalAction,
                     help="sharded engine: reduce histograms with the "
                     "Pallas TPU kernel instead of the portable "
-                    "scatter-add (config default: OFF until an "
-                    "on-device measurement justifies it; the kernel "
-                    "only ever engages on a TPU backend)")
+                    "scatter-add (config default: ON since the "
+                    "2026-07-31 on-device measurement — bit-equal, "
+                    "4.4x; the kernel only ever engages on a TPU "
+                    "backend)")
+    ap.add_argument("--device-draw", default=None,
+                    action=argparse.BooleanOptionalAction,
+                    help="sampled/sharded engines: draw sample keys "
+                    "on the device with the threefry PRNG instead of "
+                    "numpy on the host (default: auto — ON for "
+                    "accelerator backends, OFF for CPU; each is that "
+                    "backend's measured best, see "
+                    "SamplerConfig.device_draw)")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
     ap.add_argument("--min-reuse", type=int, default=512,
@@ -217,6 +228,13 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--pallas-hist applies to --engine sharded only (other "
             "engines reduce exact sparse pairs, not binned histograms)"
+        )
+    if args.device_draw is not None and engine not in (
+        "sampled", "sharded"
+    ):
+        raise SystemExit(
+            "--device-draw applies to the sampled/sharded engines "
+            "only (the exact engines do not sample)"
         )
     if args.diff_against:
         if args.mode not in ("acc", "sample"):
