@@ -99,3 +99,41 @@ def test_device_and_host_paths_agree_statistically():
         mrcs.append(aet_mrc(cri_distribute(state, T, T), machine))
     assert mrc_l1_error(mrcs[0], mrcs[1]) < 0.05
     # and the sample counts are identical: s is draw-path independent
+
+
+def test_masked_kernel_matches_prefix_kernel():
+    """The two per-ref kernel forms — valid-prefix (host draw) and
+    selection-mask (device draw) — must produce identical packed
+    pairs and cold counts for the same sample set."""
+    import jax.numpy as jnp
+
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        _build_ref_kernel,
+        _build_ref_kernel_masked,
+        pad_keys,
+    )
+
+    trace = ProgramTrace(gemm(48), MACHINE)
+    nt = trace.nests[0]
+    cfg = SamplerConfig(ratio=0.3, seed=5)
+    for ri in (0, 5):
+        highs, s = _sample_highs(nt, ri, cfg)
+        out = D.draw_sample_keys_device(nt, ri, cfg, seed=ri, batch=1 << 12)
+        assert out is not None
+        keys, chosen, s_got, _ = out
+        # masked form: the buffer exactly as the device path feeds it
+        km = _build_ref_kernel_masked(nt, ri)
+        mk, mc, mu, mcold = km(keys, chosen, tuple(highs), 64)
+        # prefix form: compact the chosen keys, pad like the host path
+        compact = np.asarray(keys)[np.asarray(chosen)]
+        chunk, n_valid = pad_keys(compact, 1)
+        kp = _build_ref_kernel(nt, ri)
+        pk, pc, pu, pcold = kp(jnp.asarray(chunk), n_valid, tuple(highs), 64)
+
+        def pairs(k, c):
+            k, c = np.asarray(k), np.asarray(c)
+            return sorted((int(a), int(b)) for a, b in zip(k, c) if b > 0)
+
+        assert pairs(mk, mc) == pairs(pk, pc)
+        assert int(mu) == int(pu)
+        assert int(mcold) == int(pcold)
