@@ -117,10 +117,9 @@ def test_masked_kernel_matches_prefix_kernel():
     nt = trace.nests[0]
     cfg = SamplerConfig(ratio=0.3, seed=5)
     for ri in (0, 5):
-        highs, s = _sample_highs(nt, ri, cfg)
         out = D.draw_sample_keys_device(nt, ri, cfg, seed=ri, batch=1 << 12)
         assert out is not None
-        keys, chosen, s_got, _ = out
+        keys, chosen, _s, highs = out
         # masked form: the buffer exactly as the device path feeds it
         km = _build_ref_kernel_masked(nt, ri)
         mk, mc, mu, mcold = km(keys, chosen, tuple(highs), 64)
