@@ -18,10 +18,10 @@ The result is bit-identical to sampler/sampled.py on any mesh size
 under either draw mode — the host numpy stream or the device threefry
 stream (sampler/draw.py; same seed + batch bucketing => same sample
 set, and the unique merge is exact) — which is the sharded path's
-correctness test. Device drawing engages on single-process meshes
-whose size divides the batch; multi-host runs keep the host stream
-(every process replays it deterministically and ships only its own
-rows).
+correctness test. Device drawing engages whenever the mesh size
+divides the batch — including multi-host, where every process replays
+the identical threefry draw on its own devices and contributes only
+the rows it owns, so no draw data crosses hosts at all.
 
 Dense engine: the jitted per-tid kernel (sampler/dense.py) is already
 vmapped over simulated threads; `run_dense_sharded` lays that batch axis
@@ -171,19 +171,20 @@ def sampled_outputs_sharded(
     )
     n_proc = jax.process_count()
     in_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-    # Device drawing on the mesh: single-process only (each process
-    # would need its shard of a buffer drawn on one device; the host
-    # stream stays the multi-host path — every process replays it
-    # deterministically) and batch must split evenly over the mesh so
-    # the buffer's batch-sized chunks reshard without padding. The
-    # realistic single-host TPU topologies (v4-8, v5e-8: power-of-2
-    # meshes dividing the 2^20 batch) and the test suite's virtual CPU
-    # mesh all qualify. An EXPLICIT device_draw=True with a
-    # non-dividing mesh raises rather than silently sampling from the
-    # other stream — the bit-identity-with-run_sampled contract is the
-    # sharded path's correctness anchor; the auto default (None)
-    # resolves to the host stream in that case.
-    use_dev_draw = _use_device_draw(cfg) and n_proc == 1
+    # Device drawing on the mesh: batch must split evenly over the
+    # mesh so the buffer's batch-sized chunks reshard without padding.
+    # The realistic single-host TPU topologies (v4-8, v5e-8: power-of-
+    # 2 meshes dividing the 2^20 batch) and the test suite's virtual
+    # CPU mesh all qualify. Multi-host works because threefry is
+    # deterministic: every process replays the identical draw on its
+    # own device and contributes only the rows its devices own
+    # (_chunk_to_global) — no cross-host draw traffic at all. An
+    # EXPLICIT device_draw=True with a non-dividing mesh raises rather
+    # than silently sampling from the other stream — the
+    # bit-identity-with-run_sampled contract is the sharded path's
+    # correctness anchor; the auto default (None) resolves to the
+    # host stream in that case.
+    use_dev_draw = _use_device_draw(cfg)
     if use_dev_draw and batch % n_dev != 0:
         if cfg.device_draw:
             raise ValueError(
@@ -250,17 +251,36 @@ def sampled_outputs_sharded(
             for d in range(n_dev):
                 decode_pairs(keys[d], counts[d], noshare, share)
 
+        def _chunk_to_global(buf, s0):
+            """One batch-sized slice of the (process-local, identical
+            on every process) draw buffer, laid out over the mesh
+            axis. Single-process: a plain resharding device_put.
+            Multi-process: each process device_puts only the rows its
+            own devices hold and the global array is assembled from
+            the single-device pieces — every process computed the same
+            buffer, so the assembly is consistent by determinism."""
+            chunk = jax.lax.slice(buf, (s0,), (s0 + batch,))
+            if n_proc == 1:
+                return jax.device_put(chunk, in_sharding)
+            rows = batch // n_dev
+            pid = jax.process_index()
+            pieces = [
+                jax.device_put(
+                    jax.lax.slice(chunk, (g * rows,), ((g + 1) * rows,)),
+                    d,
+                )
+                for g, d in enumerate(mesh.devices.flat)
+                if d.process_index == pid
+            ]
+            return jax.make_array_from_single_device_arrays(
+                (batch,), in_sharding, pieces
+            )
+
         if drawn is not None:
             B = dev_keys.shape[0]
             for s0 in range(0, B, batch):
-                kc = jax.device_put(
-                    jax.lax.slice(dev_keys, (s0,), (s0 + batch,)),
-                    in_sharding,
-                )
-                mc = jax.device_put(
-                    jax.lax.slice(dev_mask, (s0,), (s0 + batch,)),
-                    in_sharding,
-                )
+                kc = _chunk_to_global(dev_keys, s0)
+                mc = _chunk_to_global(dev_mask, s0)
                 dispatch(
                     masked_kernels[idx],
                     lambda kern, kc=kc, mc=mc: kern(

@@ -37,17 +37,26 @@ assert mesh.devices.size == 4 * n_proc, mesh.devices.size
 state, results = run_sampled_sharded(
     gemm(16), MachineConfig(), SamplerConfig(ratio=0.3, seed=0), mesh
 )
-out = [
-    {
-        "name": r.name,
-        "noshare": {str(k): v for k, v in r.noshare.items()},
-        "share": {
-            str(k): {str(a): b for a, b in h.items()}
-            for k, h in r.share.items()
-        },
-        "cold": r.cold,
-        "n": r.n_samples,
-    }
-    for r in results
-]
-print("RESULT" + str(pid) + "=" + json.dumps(out, sort_keys=True))
+# second run: device-drawn samples through the multi-host mesh (every
+# process replays the identical threefry buffer; only its own rows
+# are contributed) — compared against the single-process device path
+_, dev_results = run_sampled_sharded(
+    gemm(16), MachineConfig(),
+    SamplerConfig(ratio=0.3, seed=0, device_draw=True), mesh,
+)
+def _ser(results):
+    return [
+        {
+            "name": r.name,
+            "noshare": {str(k): v for k, v in r.noshare.items()},
+            "share": {
+                str(k): {str(a): b for a, b in h.items()}
+                for k, h in r.share.items()
+            },
+            "cold": r.cold,
+            "n": r.n_samples,
+        }
+        for r in results
+    ]
+print("RESULT" + str(pid) + "=" + json.dumps(_ser(results), sort_keys=True))
+print("RESULTDEV" + str(pid) + "=" + json.dumps(_ser(dev_results), sort_keys=True))

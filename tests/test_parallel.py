@@ -256,24 +256,36 @@ def test_two_process_multihost_matches_single():
         o, e = pr.communicate(timeout=420)
         assert pr.returncode == 0, (p, e[-3000:])
         outs.append(o)
-    per_host = [
-        json.loads(
-            [ln for ln in outs[p].splitlines()
-             if ln.startswith(f"RESULT{p}=")][0].split("=", 1)[1]
-        )
-        for p in range(2)
-    ]
-    assert per_host[0] == per_host[1], "hosts disagree"
+    def _per_host(tag):
+        docs = [
+            json.loads(
+                [ln for ln in outs[p].splitlines()
+                 if ln.startswith(f"{tag}{p}=")][0].split("=", 1)[1]
+            )
+            for p in range(2)
+        ]
+        assert docs[0] == docs[1], f"hosts disagree on {tag}"
+        return docs[0]
+
+    def _assert_matches(got, want):
+        assert [g["name"] for g in got] == [r.name for r in want]
+        for g, r in zip(got, want):
+            assert {int(k): v for k, v in g["noshare"].items()} == r.noshare
+            assert {
+                int(k): {int(a): b for a, b in h.items()}
+                for k, h in g["share"].items()
+            } == r.share
+            assert g["cold"] == r.cold and g["n"] == r.n_samples
 
     _, want = run_sampled(
         gemm(16), MachineConfig(), SamplerConfig(ratio=0.3, seed=0)
     )
-    got = per_host[0]
-    assert [g["name"] for g in got] == [r.name for r in want]
-    for g, r in zip(got, want):
-        assert {int(k): v for k, v in g["noshare"].items()} == r.noshare
-        assert {
-            int(k): {int(a): b for a, b in h.items()}
-            for k, h in g["share"].items()
-        } == r.share
-        assert g["cold"] == r.cold and g["n"] == r.n_samples
+    _assert_matches(_per_host("RESULT"), want)
+
+    # device-drawn samples over the 2-host mesh: bit-identical to the
+    # single-process device path (same threefry stream, exact merges)
+    _, want_dev = run_sampled(
+        gemm(16), MachineConfig(),
+        SamplerConfig(ratio=0.3, seed=0, device_draw=True),
+    )
+    _assert_matches(_per_host("RESULTDEV"), want_dev)
