@@ -40,17 +40,25 @@ def exp_hist(values, weights, n_bins: int = N_EXP_BINS):
     return jnp.zeros(n_bins, dtype=jnp.int64).at[e].add(weights.astype(jnp.int64))
 
 
-def sorted_k_unique(values, valid, k: int):
+def sorted_k_unique(values, valid, k: int, weights=None):
     """Exact sparse histogram with capacity k over masked int64 values,
     via one full sort + segmented reduction.
 
-    Returns (keys[k], counts[k], n_unique). Invalid entries are pushed
-    to the end via an int64 sentinel; entries beyond capacity are
-    dropped (detect via n_unique > k on host).
+    `weights=None` counts occurrences; an int64 array sums weights per
+    key instead (the merge form: folding (key, count) pair sets into
+    one). Returns (keys[k], counts[k], n_unique). Invalid entries are
+    pushed to the end via an int64 sentinel; entries beyond capacity
+    are dropped (detect via n_unique > k on host).
     """
     sentinel = jnp.int64(2**62)
     v = jnp.where(valid, values, sentinel)
-    v = jnp.sort(v)
+    if weights is None:
+        v = jnp.sort(v)
+        w = None
+    else:
+        order = jnp.argsort(v)
+        v = v[order]
+        w = weights[order]
     first = jnp.concatenate(
         [jnp.array([True]), v[1:] != v[:-1]]
     ) & (v != sentinel)
@@ -63,10 +71,13 @@ def sorted_k_unique(values, valid, k: int):
         .at[jnp.where(first, seg_c, k)]
         .set(v)[:k]
     )
+    add = is_valid.astype(jnp.int64) if w is None else jnp.where(
+        is_valid, w, 0
+    )
     counts = (
         jnp.zeros(k + 1, dtype=jnp.int64)
         .at[seg_c]
-        .add(is_valid.astype(jnp.int64))[:k]
+        .add(add)[:k]
     )
     return keys, counts, n_unique
 
@@ -89,7 +100,9 @@ def _round_hash(values, salt: int, h_slots: int):
     return x & (h_slots - 1)
 
 
-def fixed_k_unique(values, valid, k: int, rounds: int | None = None):
+def fixed_k_unique(
+    values, valid, k: int, rounds: int | None = None, weights=None
+):
     """Exact sparse histogram with capacity k over masked int64 values.
 
     Sort-free on the common path: a few rounds of scatter-max
@@ -120,20 +133,25 @@ def fixed_k_unique(values, valid, k: int, rounds: int | None = None):
     sorted_k_unique directly instead.
 
     Values must stay below the 2^62 invalid-entry sentinel of the
-    sorted fallback (every packed reuse key does). Returns
-    (keys[k], counts[k], n_unique); empty output slots carry count 0
-    (the key field of an empty slot is -1, but only counts identify
-    emptiness); entries beyond capacity are dropped (detect via
-    n_unique > k on host).
+    sorted fallback (every packed reuse key does). `weights=None`
+    counts occurrences; an int64 array sums weights per key instead
+    (the merge form — folding (key, count) pair sets back into one,
+    as the scan-fused kernels do per chunk; weights must be >= 0 and
+    a valid entry's weight should be > 0 or its key may be reported
+    with count 0). Returns (keys[k], counts[k], n_unique); empty
+    output slots carry count 0 (the key field of an empty slot is -1,
+    but only counts identify emptiness); entries beyond capacity are
+    dropped (detect via n_unique > k on host).
     """
     if rounds is None:
         rounds = 2 if k <= 64 else 3
     if rounds < 1:  # degenerate: nothing can resolve, sort directly
-        return sorted_k_unique(values, valid, k)
+        return sorted_k_unique(values, valid, k, weights=weights)
     h_slots = max(1024, 4 * k)
     h_slots = 1 << (h_slots - 1).bit_length()
     neg = jnp.iinfo(jnp.int64).min
     remaining = valid
+    w_add = None if weights is None else weights.astype(jnp.int64)
     key_tabs, cnt_tabs = [], []
     for r in range(rounds):
         h = _round_hash(values, r * 0x9E3779B97F4A7C15 + r, h_slots)
@@ -145,7 +163,7 @@ def fixed_k_unique(values, valid, k: int, rounds: int | None = None):
         cnt = (
             jnp.zeros(h_slots + 1, dtype=jnp.int64)
             .at[jnp.where(won, h, h_slots)]
-            .add(1)
+            .add(1 if w_add is None else w_add)
         )
         key_tabs.append(tab[:h_slots])
         cnt_tabs.append(cnt[:h_slots])
@@ -165,6 +183,6 @@ def fixed_k_unique(values, valid, k: int, rounds: int | None = None):
     n_unique = occupied.sum().astype(jnp.int64)
     return jax.lax.cond(
         jnp.any(remaining),
-        lambda: sorted_k_unique(values, valid, k),
+        lambda: sorted_k_unique(values, valid, k, weights=weights),
         lambda: (keys, counts, n_unique),
     )

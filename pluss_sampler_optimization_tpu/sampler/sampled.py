@@ -328,6 +328,60 @@ def _build_ref_kernel(nt: NestTrace, ref_idx: int):
     return kernel
 
 
+def _build_ref_kernel_scan(nt: NestTrace, ref_idx: int):
+    """Whole-buffer twin of the masked kernel: the chunk loop lives
+    inside the jit as a lax.scan, with the sparse (key, count) pairs
+    merged ON DEVICE between chunks (weighted fixed_k_unique over the
+    2*capacity concatenated pair sets — a few hundred elements).
+
+    One dispatch + one result fetch per ref, instead of one fetch per
+    chunk: over a tunneled link every round trip costs ~70 ms, so at
+    GEMM N=4096 (~280 chunks across refs) the per-chunk drain alone
+    was a ~20 s latency floor. Memory stays chunk-bounded — scan keeps
+    one chunk's classify intermediates live at a time.
+
+    Returns (keys, counts, max_nu, cold) where max_nu is the maximum
+    of every per-chunk and merged unique count — the host regrows
+    capacity and reruns when it exceeds the dispatch capacity, same
+    contract as the other kernel forms.
+    """
+    check_packed_ratios(nt)
+
+    @functools.partial(
+        jax.jit, static_argnames=("highs", "capacity", "n_chunks")
+    )
+    def kernel(keys_B, mask_B, highs: tuple, capacity: int, n_chunks: int):
+        kb = keys_B.reshape(n_chunks, -1)
+        mb = mask_B.reshape(n_chunks, -1)
+
+        def step(carry, xm):
+            ck, cc, cold, max_nu = carry
+            x, msk = xm
+            samples = decode_sample_keys(x, highs)
+            packed, _, _, found = classify_samples(nt, ref_idx, samples)
+            k2, c2, nu = fixed_k_unique(packed, found & msk, capacity)
+            mk, mc, mnu = fixed_k_unique(
+                jnp.concatenate([ck, k2]),
+                jnp.concatenate([cc, c2]) > 0,
+                capacity,
+                weights=jnp.concatenate([cc, c2]),
+            )
+            cold = cold + jnp.sum((~found & msk).astype(jnp.int64))
+            max_nu = jnp.maximum(max_nu, jnp.maximum(nu, mnu))
+            return (mk, mc, cold, max_nu), None
+
+        init = (
+            jnp.full(capacity, -1, dtype=jnp.int64),
+            jnp.zeros(capacity, dtype=jnp.int64),
+            jnp.int64(0),
+            jnp.int64(0),
+        )
+        (mk, mc, cold, max_nu), _ = jax.lax.scan(step, init, (kb, mb))
+        return mk, mc, max_nu, cold
+
+    return kernel
+
+
 def _build_ref_kernel_masked(nt: NestTrace, ref_idx: int):
     """Masked twin of _build_ref_kernel for device-drawn samples.
 
@@ -462,7 +516,7 @@ def _program_kernels(program: Program, machine: MachineConfig):
         for ri in range(nt.tables.n_refs):
             kernels.append(
                 (k, ri, _build_ref_kernel(nt, ri),
-                 _build_ref_kernel_masked(nt, ri))
+                 _build_ref_kernel_scan(nt, ri))
             )
     return trace, kernels
 
@@ -487,16 +541,16 @@ def warmup(
         batch = default_batch()
     trace, kernels = _program_kernels(program, machine)
     drawn_buckets: set = set()
-    for k, ri, kernel, kernel_m in kernels:
+    for k, ri, kernel, kernel_s in kernels:
         nt = trace.nests[k]
         highs, s = _sample_highs(nt, ri, cfg)
         if s == 0:  # no drawable points (degenerate triangular ref)
             continue
         if _use_device_draw(cfg):
-            # compile the masked kernel at the shared (batch,) shape
-            # and the draw kernel at this ref's bucket size (rect
-            # buckets are shared across refs, so the set dedups; tri
-            # kernels are per-ref closures)
+            # compile the scan-fused kernel at the ref's planned
+            # (buffer, n_chunks) shape and the draw kernel at its
+            # bucket size (rect buckets are shared across refs, so the
+            # set dedups; tri kernels are per-ref closures)
             from .draw import _get_tri_kernel, _rect_draw_kernel, plan_draw
 
             plan = plan_draw(nt, ri, cfg, batch)
@@ -512,9 +566,10 @@ def warmup(
                         jax.random.key(0), jnp.int64(space_box),
                         jnp.int64(s_plan),
                     ))
-                dummy = jnp.zeros(batch, dtype=jnp.int64)
-                jax.block_until_ready(kernel_m(
-                    dummy, dummy < 0, tuple(highs), capacity
+                dummy = jnp.zeros(B, dtype=jnp.int64)
+                jax.block_until_ready(kernel_s(
+                    dummy, dummy < 0, tuple(highs), capacity,
+                    B // batch,
                 ))
                 continue
             # over-budget refs take the host path below
@@ -635,7 +690,7 @@ def sampled_outputs(
         os.makedirs(checkpoint_dir, exist_ok=True)
         tag_of = _checkpoint_tagger(program, machine, cfg, batch)
     results = []
-    for idx, (k, ri, kernel, kernel_m) in enumerate(kernels):
+    for idx, (k, ri, kernel, kernel_s) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
         ck_path = ck_tag = None
@@ -646,10 +701,11 @@ def sampled_outputs(
             if prior is not None:
                 results.append(prior)
                 continue
-        # Device path first: draw + dedup + thin on the device, feed
-        # the masked kernel buffer chunks that never touch the host
-        # (sampler/draw.py — the host<->device link can be a network
-        # tunnel at ~70 MB/s, while the device-side compute for a
+        # Device path first: draw + dedup + thin on the device, then
+        # ONE scan-fused dispatch over the whole buffer with on-device
+        # chunk merging (sampler/draw.py + _build_ref_kernel_scan —
+        # the host<->device link can be a network tunnel at ~70 MB/s
+        # with ~70 ms round trips, while the device-side compute for a
         # batch is ~0.1 ms). Falls back to the host numpy draw when
         # disabled or when the ref's buffer would exceed the device
         # budget.
@@ -690,17 +746,12 @@ def sampled_outputs(
             decode_pairs(keys, counts, noshare, share)
 
         if drawn is not None:
-            B = dev_keys.shape[0]
-            for s0 in range(0, B, batch):
-                kc = jax.lax.slice(dev_keys, (s0,), (s0 + batch,))
-                mc = jax.lax.slice(dev_mask, (s0,), (s0 + batch,))
+            n_chunks = dev_keys.shape[0] // batch
 
-                def redo(c2, kc=kc, mc=mc):
-                    return kernel_m(kc, mc, tuple(highs), c2)
+            def redo(c2, dk=dev_keys, dm=dev_mask, nc=n_chunks):
+                return kernel_s(dk, dm, tuple(highs), c2, nc)
 
-                pending.append((redo(cap), redo, cap))
-                if len(pending) >= 4:
-                    drain(pending.pop(0))
+            pending.append((redo(cap), redo, cap))
         else:
             for s0 in range(0, n_samples, batch):
                 chunk, n_valid = pad_keys(
