@@ -82,6 +82,23 @@ def sorted_k_unique(values, valid, k: int, weights=None):
     return keys, counts, n_unique
 
 
+def merge_pair_sets(ck, cc, k2, c2, capacity: int):
+    """Fold two fixed-capacity (key, count) pair sets into one: the
+    scan-fused kernels' between-chunk merge (weighted unique over the
+    concatenated pairs; empty slots are identified by count 0, so the
+    validity mask is `counts > 0`). Single source of truth for the
+    single-device and mesh-sharded scan kernels — their bit-identity
+    contract depends on this merge being the same code. Returns
+    (keys[capacity], counts[capacity], n_unique)."""
+    counts = jnp.concatenate([cc, c2])
+    return fixed_k_unique(
+        jnp.concatenate([ck, k2]),
+        counts > 0,
+        capacity,
+        weights=counts,
+    )
+
+
 def _round_hash(values, salt: int, h_slots: int):
     """SplitMix64-style avalanche of (values ^ salt), masked to a slot.
 

@@ -42,7 +42,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import MachineConfig, SamplerConfig
 from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
-from ..ops.histogram import N_EXP_BINS, exp_hist, fixed_k_unique
+from ..ops.histogram import (
+    N_EXP_BINS,
+    exp_hist,
+    fixed_k_unique,
+    merge_pair_sets,
+)
 from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
 from ..sampler.draw import draw_sample_keys_device
@@ -64,15 +69,20 @@ from .mesh import build_mesh
 
 def _build_sharded_ref_kernel(
     nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int,
-    use_pallas_hist: bool, masked: bool = False,
+    use_pallas_hist: bool, scan: bool = False,
 ):
     """jit(shard_map) kernel: sharded samples -> reduced histograms.
 
-    The second operand is either a replicated valid-prefix count
-    (masked=False, the host draw's padded-chunk form) or a sharded
-    per-slot selection mask (masked=True, the device draw's buffer
-    form, sampler/draw.py); everything downstream of the mask is one
-    body, so the two draw modes cannot diverge in reduction semantics.
+    scan=False (the host draw's form): one padded chunk, replicated
+    valid-prefix count, one reduction per call. scan=True (the device
+    draw's form, sampler/draw.py): the WHOLE drawn buffer arrives
+    sharded along with its selection mask, each device lax.scans its
+    local rows in chunk-sized slices with the sparse pair sets merged
+    on device between steps (weighted fixed_k_unique), and the mesh
+    reduction happens once at the end — one dispatch and one fetch
+    per ref, no per-chunk host round trips. The pair merges and the
+    psum'd histogram are partition- and order-invariant, so both
+    forms produce identical results for the same sample set.
     """
     axis = mesh.axis_names[0]
     check_packed_ratios(nt)
@@ -82,42 +92,88 @@ def _build_sharded_ref_kernel(
     else:
         _hist_fn = exp_hist
 
-    def local_fn(sample_keys, valid, highs):
-        # int64 mixed-radix keys on the wire (8 bytes/sample); decode
-        # and the padding weight mask both happen device-side
+    def _classify(sample_keys, w, highs):
+        """Shared per-slice body: classify + the three local outputs."""
         samples = decode_sample_keys(sample_keys, highs)
         packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
-        if masked:
-            w = valid
-        else:
-            local_b = sample_keys.shape[0]
-            base = jax.lax.axis_index(axis).astype(jnp.int64) * local_b
-            w = base + jnp.arange(local_b, dtype=jnp.int64) < valid
-        # scalable output: dense pow2 noshare histogram, psum over ICI
-        nosh_hist = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
-        nosh_hist = jax.lax.psum(nosh_hist, axis)
-        cold = jax.lax.psum(jnp.sum((~found & w).astype(jnp.int64)), axis)
-        # exact output: per-device unique (reuse, class) pairs,
-        # all-gathered so every output is fully replicated — a few KB
-        # over ICI, and the one thing that makes multi-host fetch work
-        # (device_get of an axis-sharded output would touch
-        # non-addressable devices on other hosts)
+        nosh = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
+        cold = jnp.sum((~found & w).astype(jnp.int64))
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
-        keys = jax.lax.all_gather(keys, axis)  # (n_dev, capacity)
-        counts = jax.lax.all_gather(counts, axis)
-        n_u = jax.lax.all_gather(n_unique, axis)  # (n_dev,)
-        return nosh_hist, cold, keys, counts, n_u
+        return nosh, cold, keys, counts, n_unique
 
-    def entry(sample_keys, valid, highs: tuple):
+    def _mesh_reduce(nosh, cold, keys, counts, n_u):
+        """psum the dense outputs over ICI; all_gather the exact pairs
+        so every output is fully replicated — a few KB over ICI, and
+        the one thing that makes multi-host fetch work (device_get of
+        an axis-sharded output would touch non-addressable devices on
+        other hosts)."""
+        return (
+            jax.lax.psum(nosh, axis),
+            jax.lax.psum(cold, axis),
+            jax.lax.all_gather(keys, axis),  # (n_dev, capacity)
+            jax.lax.all_gather(counts, axis),
+            jax.lax.all_gather(n_u, axis),  # (n_dev,)
+        )
+
+    if scan:
+        def local_fn(sample_keys, mask, highs, n_chunks):
+            kb = sample_keys.reshape(n_chunks, -1)
+            mb = mask.reshape(n_chunks, -1)
+
+            def step(carry, xm):
+                ck, cc, cold, max_nu, nh = carry
+                x, msk = xm
+                nosh, c, k2, c2, nu = _classify(x, msk, highs)
+                mk, mc, mnu = merge_pair_sets(ck, cc, k2, c2, capacity)
+                return (
+                    mk, mc, cold + c,
+                    jnp.maximum(max_nu, jnp.maximum(nu, mnu)),
+                    nh + nosh,
+                ), None
+
+            init = (
+                jnp.full(capacity, -1, dtype=jnp.int64),
+                jnp.zeros(capacity, dtype=jnp.int64),
+                jnp.int64(0),
+                jnp.int64(0),
+                jnp.zeros(N_EXP_BINS, dtype=jnp.int64),
+            )
+            (mk, mc, cold, max_nu, nh), _ = jax.lax.scan(
+                step, init, (kb, mb)
+            )
+            return _mesh_reduce(nh, cold, mk, mc, max_nu)
+
+        def entry(sample_keys, mask, highs: tuple, n_chunks: int):
+            return jax.shard_map(
+                functools.partial(
+                    local_fn, highs=highs, n_chunks=n_chunks
+                ),
+                mesh=mesh,
+                in_specs=(P(axis), P(axis)),
+                out_specs=(P(), P(), P(), P(), P()),
+                # all_gather outputs ARE replicated, but the static
+                # varying-axes check cannot infer that
+                check_vma=False,
+            )(sample_keys, mask)
+
+        return jax.jit(entry, static_argnames=("highs", "n_chunks"))
+
+    def local_fn(sample_keys, n_valid, highs):
+        # int64 mixed-radix keys on the wire (8 bytes/sample); decode
+        # and the padding weight mask both happen device-side
+        local_b = sample_keys.shape[0]
+        base = jax.lax.axis_index(axis).astype(jnp.int64) * local_b
+        w = base + jnp.arange(local_b, dtype=jnp.int64) < n_valid
+        return _mesh_reduce(*_classify(sample_keys, w, highs))
+
+    def entry(sample_keys, n_valid, highs: tuple):
         return jax.shard_map(
             functools.partial(local_fn, highs=highs),
             mesh=mesh,
-            in_specs=(P(axis), P(axis) if masked else P()),
+            in_specs=(P(axis), P()),
             out_specs=(P(), P(), P(), P(), P()),
-            # all_gather outputs ARE replicated, but the static
-            # varying-axes check cannot infer that
             check_vma=False,
-        )(sample_keys, valid)
+        )(sample_keys, n_valid)
 
     return jax.jit(entry, static_argnames=("highs",))
 
@@ -129,7 +185,7 @@ def _sharded_program_kernels(
     mesh: jax.sharding.Mesh,
     capacity: int,
     use_pallas_hist: bool,
-    masked: bool = False,
+    scan: bool = False,
 ):
     trace = ProgramTrace(program, machine)
     kernels = []
@@ -144,7 +200,7 @@ def _sharded_program_kernels(
             kernels.append(
                 [k, ri,
                  _build_sharded_ref_kernel(
-                     nt, ri, mesh, capacity, use_pallas_hist, masked
+                     nt, ri, mesh, capacity, use_pallas_hist, scan
                  ),
                  capacity]  # capacity travels with the kernel: a
             )                # regrown kernel returns wider arrays
@@ -178,7 +234,7 @@ def sampled_outputs_sharded(
     # CPU mesh all qualify. Multi-host works because threefry is
     # deterministic: every process replays the identical draw on its
     # own device and contributes only the rows its devices own
-    # (_chunk_to_global) — no cross-host draw traffic at all. An
+    # (_buffer_to_global) — no cross-host draw traffic at all. An
     # EXPLICIT device_draw=True with a non-dividing mesh raises rather
     # than silently sampling from the other stream — the
     # bit-identity-with-run_sampled contract is the sharded path's
@@ -195,14 +251,14 @@ def sampled_outputs_sharded(
                 "mesh size or device_draw=None/False."
             )
         use_dev_draw = False
-    masked_kernels = None
+    scan_kernels = None
     if use_dev_draw:
-        # lru-cached like the host-form kernels (masked=True keys a
+        # lru-cached like the host-form kernels (scan=True keys a
         # separate entry), so repeat calls and capacity regrows are
         # paid once
-        _, masked_kernels = _sharded_program_kernels(
+        _, scan_kernels = _sharded_program_kernels(
             program, machine, mesh, capacity, cfg.use_pallas_hist,
-            masked=True,
+            scan=True,
         )
     results = []
     dense_noshare = []
@@ -231,11 +287,11 @@ def sampled_outputs_sharded(
 
         def dispatch(holder, run_kernel, rebuild):
             """One chunk through holder's trailing [kernel, capacity]
-            entries (holder is mutated IN PLACE — either the lru-cached
-            [k, ri, kernel, cap] row or a masked_kernels [kernel, cap]
-            pair — so a capacity regrow is retained and paid once, not
-            on every later chunk/call); mirrors sampler/sampled.py's
-            drain loop."""
+            entries (holder is mutated IN PLACE — an lru-cached
+            [k, ri, kernel, cap] row from either kernel list — so a
+            capacity regrow is retained and paid once, not on every
+            later chunk/call); mirrors sampler/sampled.py's drain
+            loop."""
             nonlocal cold, dense
             while True:
                 kern, c2 = holder[-2], holder[-1]
@@ -251,46 +307,44 @@ def sampled_outputs_sharded(
             for d in range(n_dev):
                 decode_pairs(keys[d], counts[d], noshare, share)
 
-        def _chunk_to_global(buf, s0):
-            """One batch-sized slice of the (process-local, identical
-            on every process) draw buffer, laid out over the mesh
-            axis. Single-process: a plain resharding device_put.
-            Multi-process: each process device_puts only the rows its
-            own devices hold and the global array is assembled from
-            the single-device pieces — every process computed the same
+        def _buffer_to_global(buf):
+            """The whole (process-local, identical on every process)
+            draw buffer, laid out over the mesh axis. Single-process:
+            a plain resharding device_put. Multi-process: each process
+            device_puts only the contiguous block of rows its own
+            devices hold and the global array is assembled from the
+            single-device pieces — every process computed the same
             buffer, so the assembly is consistent by determinism."""
-            chunk = jax.lax.slice(buf, (s0,), (s0 + batch,))
             if n_proc == 1:
-                return jax.device_put(chunk, in_sharding)
-            rows = batch // n_dev
+                return jax.device_put(buf, in_sharding)
+            B = buf.shape[0]
+            rows = B // n_dev
             pid = jax.process_index()
             pieces = [
                 jax.device_put(
-                    jax.lax.slice(chunk, (g * rows,), ((g + 1) * rows,)),
+                    jax.lax.slice(buf, (g * rows,), ((g + 1) * rows,)),
                     d,
                 )
                 for g, d in enumerate(mesh.devices.flat)
                 if d.process_index == pid
             ]
             return jax.make_array_from_single_device_arrays(
-                (batch,), in_sharding, pieces
+                (B,), in_sharding, pieces
             )
 
         if drawn is not None:
-            B = dev_keys.shape[0]
-            for s0 in range(0, B, batch):
-                kc = _chunk_to_global(dev_keys, s0)
-                mc = _chunk_to_global(dev_mask, s0)
-                dispatch(
-                    masked_kernels[idx],
-                    lambda kern, kc=kc, mc=mc: kern(
-                        kc, mc, tuple(highs)
-                    ),
-                    lambda c2, nt=nt, ri=ri: _build_sharded_ref_kernel(
-                        nt, ri, mesh, c2, cfg.use_pallas_hist,
-                        masked=True,
-                    ),
-                )
+            n_chunks = dev_keys.shape[0] // batch
+            kc = _buffer_to_global(dev_keys)
+            mc = _buffer_to_global(dev_mask)
+            dispatch(
+                scan_kernels[idx],
+                lambda kern, kc=kc, mc=mc, nc=n_chunks: kern(
+                    kc, mc, tuple(highs), nc
+                ),
+                lambda c2, nt=nt, ri=ri: _build_sharded_ref_kernel(
+                    nt, ri, mesh, c2, cfg.use_pallas_hist, scan=True
+                ),
+            )
         else:
             for s0 in range(0, n_samples, step):
                 chunk, n_valid = pad_keys(

@@ -43,7 +43,7 @@ import numpy as np
 from ..config import MachineConfig, SamplerConfig
 from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
-from ..ops.histogram import fixed_k_unique
+from ..ops.histogram import fixed_k_unique, merge_pair_sets
 from ..runtime.hist import PRIState
 from .nextuse import INF
 
@@ -360,12 +360,7 @@ def _build_ref_kernel_scan(nt: NestTrace, ref_idx: int):
             samples = decode_sample_keys(x, highs)
             packed, _, _, found = classify_samples(nt, ref_idx, samples)
             k2, c2, nu = fixed_k_unique(packed, found & msk, capacity)
-            mk, mc, mnu = fixed_k_unique(
-                jnp.concatenate([ck, k2]),
-                jnp.concatenate([cc, c2]) > 0,
-                capacity,
-                weights=jnp.concatenate([cc, c2]),
-            )
+            mk, mc, mnu = merge_pair_sets(ck, cc, k2, c2, capacity)
             cold = cold + jnp.sum((~found & msk).astype(jnp.int64))
             max_nu = jnp.maximum(max_nu, jnp.maximum(nu, mnu))
             return (mk, mc, cold, max_nu), None
