@@ -136,3 +136,18 @@ def test_masked_kernel_matches_prefix_kernel():
         assert pairs(mk, mc) == pairs(pk, pc)
         assert int(mu) == int(pu)
         assert int(mcold) == int(pcold)
+
+
+def test_scan_capacity_regrow_device_draw():
+    """A deliberately tiny starting capacity must regrow (the scan
+    kernel reports max per-chunk/merged unique counts; the drain loop
+    recompiles larger) and converge to results identical to a
+    roomy-capacity run."""
+    cfg = SamplerConfig(ratio=0.4, seed=2, device_draw=True)
+    state_small, res_small = run_sampled(gemm(16), MACHINE, cfg, capacity=2)
+    state_big, res_big = run_sampled(gemm(16), MACHINE, cfg)
+    for a, b in zip(res_small, res_big):
+        assert a.name == b.name
+        assert a.noshare == b.noshare
+        assert a.share == b.share
+        assert a.cold == b.cold and a.n_samples == b.n_samples
