@@ -155,7 +155,8 @@ def probe_accelerator(timeout_s: float) -> tuple[bool, list]:
 
 
 def guarded_backend_init(
-    init_fn, timeout_s: float, on_timeout=None, probe_was_cached=True
+    init_fn, timeout_s: float, on_timeout=None, probe_was_cached=True,
+    spent_fn=None,
 ):
     """Run the first backend touches (device claim AND first compile)
     under a watchdog bounded by the --warmup-timeout budget.
@@ -198,13 +199,18 @@ def guarded_backend_init(
         sys.stderr.flush()
         argv = [
             a for i, a in enumerate(sys.argv)
-            if a != "--accel-hang-fallback"
-            and (i == 0 or sys.argv[i - 1] != "--accel-hang-fallback")
+            if a not in ("--accel-hang-fallback", "--extras-spent")
+            and (i == 0 or sys.argv[i - 1] not in (
+                "--accel-hang-fallback", "--extras-spent"))
         ]
-        os.execv(
-            sys.executable,
-            [sys.executable] + argv + ["--accel-hang-fallback", kind],
-        )
+        extra_argv = ["--accel-hang-fallback", kind]
+        if spent_fn is not None:
+            # the re-exec'd process must keep charging the wall time
+            # this one burned against --extras-deadline — without it
+            # the fresh process would happily start extras 30+ min
+            # into the harness's outer budget
+            extra_argv += ["--extras-spent", f"{spent_fn():.0f}"]
+        os.execv(sys.executable, [sys.executable] + argv + extra_argv)
 
     threading.Thread(target=fire, daemon=True).start()
     try:
@@ -313,6 +319,16 @@ def main() -> int:
                     "tunnel is declared within this bound and the "
                     "bench falls back to CPU (0 = trust the backend, "
                     "no probe, no watchdog)")
+    ap.add_argument("--extras-deadline", type=float, default=2400.0,
+                    help="wall-clock budget (seconds, from process "
+                    "start) for the post-headline extras (the "
+                    "periodic-exact secondary row and the second "
+                    "model): if the headline work already consumed "
+                    "the budget — e.g. a cold-cache TPU warm-up at "
+                    "~1-1.5 min per remote compile — the extras are "
+                    "skipped WITH a recorded reason so the one JSON "
+                    "line the driver consumes is never lost to a "
+                    "harness timeout mid-extra (0 = no deadline)")
     ap.add_argument("--warmup-timeout", type=float, default=1800.0,
                     help="separate watchdog for init+warm-up AFTER a "
                     "probe pass: the chip is known alive, but kernel "
@@ -326,12 +342,35 @@ def main() -> int:
                     "hang (round 2 saw a compile service die 25 min "
                     "in) is still bounded by this flag "
                     "(0 = no warm-up watchdog)")
+    ap.add_argument("--extras-spent", type=float, default=0.0,
+                    help=argparse.SUPPRESS)  # internal: wall seconds
+    # already burned by a predecessor process before an accel-hang
+    # re-exec; charged against --extras-deadline
     ap.add_argument("--accel-hang-fallback", choices=["cached", "live"],
                     default=None, help=argparse.SUPPRESS)  # internal:
     # set by the guarded_backend_init re-exec when the probe passed
     # (via a cached marker or a live attempt) but the main process's
     # backend init/first compile hung; forces the CPU path
     args = ap.parse_args()
+    t_process_start = time.monotonic()
+
+    def extras_budget_left(tag: str, extra: dict) -> bool:
+        """Post-headline extras run only inside --extras-deadline; a
+        skip records which extra and why, so the JSON explains the
+        missing row instead of silently omitting it."""
+        if args.extras_deadline <= 0:
+            return True
+        spent = time.monotonic() - t_process_start + args.extras_spent
+        if spent < args.extras_deadline:
+            return True
+        extra.setdefault("extras_skipped", []).append({
+            "extra": tag,
+            "reason": f"wall clock {spent:.0f}s exceeded "
+            f"--extras-deadline {args.extras_deadline:.0f}s before "
+            "this extra started (headline work, e.g. a cold-cache "
+            "device warm-up, consumed the budget)",
+        })
+        return False
 
     device_fallback = False
     probe_evidence: list = []
@@ -444,6 +483,9 @@ def main() -> int:
             first_touch,
             args.warmup_timeout,
             probe_was_cached=probe_was_cached,
+            spent_fn=lambda: (
+                time.monotonic() - t_process_start + args.extras_spent
+            ),
         )
     else:
         first_touch()
@@ -600,7 +642,11 @@ def main() -> int:
     # the round-3 exact path is within ~1.4x of the 10%-sampled run at
     # the north-star config with zero approximation error, and the
     # driver's JSON should carry that evidence.
-    if args.engine == "sampled" and not args.skip_baseline:
+    if (
+        args.engine == "sampled"
+        and not args.skip_baseline
+        and extras_budget_left("periodic_exact", extra)
+    ):
         px: dict = {}
         extra["periodic_exact"] = px  # filled in place: a later
         # scoring error must not discard the measured run
@@ -636,7 +682,7 @@ def main() -> int:
 
     # Second model, sampled engine vs the serial oracle: evidence that
     # the IR-generic engine's throughput story is not GEMM-specific.
-    if args.second_model:
+    if args.second_model and extras_budget_left("second_model", extra):
         sprog = REGISTRY[args.second_model](args.second_n)
         try:
             warmup(sprog, machine, cfg)
