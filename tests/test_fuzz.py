@@ -107,8 +107,8 @@ def _random_machine(seed: int) -> MachineConfig:
 
 
 # 20 in CI (~75 s both checks); swept clean offline with zero
-# mismatches (2026-07-31): dense and periodic seeds 20-299, stream
-# seeds 20-119
+# mismatches (2026-07-31): dense, periodic, stream, AND the device
+# draw, all at seeds 20-299
 SEEDS = list(range(20))
 
 
