@@ -95,6 +95,42 @@ def main() -> int:
     )
     t = med_time(uniq, packed, found & w)
     print(f"fixed_k_unique:  {t * 1e3:9.2f} ms")
+
+    # The redesigned engine's stages: on-device draw (threefry +
+    # sort-dedup + priority thinning) and the scan-fused whole-buffer
+    # kernel — the two dispatches a ref actually costs since the
+    # round-3 transfer redesign.
+    from pluss_sampler_optimization_tpu.sampler.draw import (
+        draw_sample_keys_device,
+    )
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        _build_ref_kernel_scan,
+    )
+
+    cfg_draw = SamplerConfig(ratio=0.1, seed=0, device_draw=True)
+    t0 = time.perf_counter()
+    drawn = draw_sample_keys_device(nt, args.ref, cfg_draw, 0, batch)
+    t_cold = time.perf_counter() - t0
+    if drawn is None:
+        print("device draw:     declined (over budget / empty space)")
+        return 0
+    dk, dm, s, dhighs = drawn
+    ts = []
+    for r in range(1, 4):
+        t0 = time.perf_counter()
+        jax.block_until_ready(
+            draw_sample_keys_device(nt, args.ref, cfg_draw, r, batch)[0]
+        )
+        ts.append(time.perf_counter() - t0)
+    print(f"device draw:     {sorted(ts)[1] * 1e3:9.2f} ms  "
+          f"(cold {t_cold:.1f} s; B={dk.shape[0]}, s={s})")
+
+    kscan = _build_ref_kernel_scan(nt, args.ref)
+    nc = dk.shape[0] // batch
+    t = med_time(
+        lambda: kscan(dk, dm, tuple(dhighs), 64, nc), reps=3
+    )
+    print(f"scan kernel:     {t * 1e3:9.2f} ms  (n_chunks={nc})")
     return 0
 
 
