@@ -250,6 +250,17 @@ def sampled_outputs_sharded(
                 "a different stream than run_sampled. Use a dividing "
                 "mesh size or device_draw=None/False."
             )
+        import warnings
+
+        warnings.warn(
+            f"device_draw auto-default downgrades to the host draw "
+            f"stream: mesh size {n_dev} does not divide the batch "
+            f"({batch}); results are statistically equivalent to "
+            "run_sampled's device stream but not bit-identical. Pass "
+            "a dividing mesh size (or device_draw=False on both "
+            "engines) for bit-identity.",
+            stacklevel=2,
+        )
         use_dev_draw = False
     scan_kernels = None
     if use_dev_draw:

@@ -55,10 +55,19 @@ import numpy as np
 DEVICE_DRAW_MAX_SLOTS = 1 << 28
 
 # Rejection sentinel: strictly greater than every valid flat key.
-# plan_draw routes space_box >= 2^63 - 1 to the host path (which
-# raises the documented NotImplementedError), so valid keys are
-# always <= 2^63 - 3 < _SENT.
+# plan_draw routes space_box >= 2^46 to the host path (see
+# _DEVICE_DRAW_MAX_SPACE below), so device-drawn keys are always
+# far below _SENT.
 _SENT = np.iinfo(np.int64).max
+
+# jax.random.randint maps 64 random bits onto [0, space) by modulo, a
+# systematic bias of ~space/2^64 relative toward low keys. Capping the
+# device path at 2^46 keeps that bias below 2^-18 — the bound the
+# docstring promises — and routes anything larger to the host numpy
+# draw, which is unbiased (Lemire-style bounded rejection). Every
+# registered model's box is far below this (GEMM N=8192 depth-3 refs:
+# ~2^39); only hypothetical nests near the int64 edge are affected.
+_DEVICE_DRAW_MAX_SPACE = 1 << 46
 
 
 def bucket_size(m: int, batch: int) -> int:
@@ -71,9 +80,11 @@ def plan_draw(nt, ref_idx: int, cfg, batch: int):
     """The device-draw plan for one ref: (B, tri?, s, highs, excl,
     space_box), or None when the ref cannot take the device path
     (s == 0, empty tri space, a buffer beyond DEVICE_DRAW_MAX_SLOTS,
-    or a box at the int64 edge where the sentinel would alias valid
-    keys — the host path raises its documented error there). Single
-    source of truth for draw_sample_keys_device and warmup()."""
+    or a box beyond _DEVICE_DRAW_MAX_SPACE, where randint's modulo
+    bias would exceed the documented 2^-18 bound — the host draw is
+    unbiased at any size, and raises its documented error only past
+    int64 flat keys). Single source of truth for
+    draw_sample_keys_device and warmup()."""
     from .sampled import _sample_plan
 
     highs, s, space_valid = _sample_plan(nt, ref_idx, cfg)
@@ -84,7 +95,9 @@ def plan_draw(nt, ref_idx: int, cfg, batch: int):
     space_box = 1
     for h in highs:
         space_box *= h
-    if space_box >= _SENT:
+    if space_box >= _DEVICE_DRAW_MAX_SPACE:
+        # modulo bias would exceed the documented 2^-18 bound (and at
+        # >= 2^63-1 the sentinel would alias valid keys); host path
         return None
     if tri:
         # margin scales by the box/valid ratio the rejection will eat
@@ -181,9 +194,11 @@ def draw_sample_keys_device(
     Deterministic in (cfg.seed-derived seed): threefry bits are
     backend-invariant, so CPU tests and TPU benches see the same
     sample sets. The [0, space) draw carries jax.random.randint's
-    modulo bias of at most space/2^64 < 2^-18 relative — orders of
-    magnitude below sampling noise (the host numpy path is unbiased;
-    the two paths are statistically, not bitwise, identical).
+    modulo bias of at most space/2^64 relative; plan_draw enforces
+    space < _DEVICE_DRAW_MAX_SPACE = 2^46, keeping it below 2^-18 —
+    orders of magnitude under sampling noise (the host numpy path is
+    unbiased; the two paths are statistically, not bitwise,
+    identical).
     """
     plan = plan_draw(nt, ref_idx, cfg, batch)
     if plan is None:

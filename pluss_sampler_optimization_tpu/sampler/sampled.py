@@ -385,6 +385,12 @@ def _build_ref_kernel_masked(nt: NestTrace, ref_idx: int):
     prefix, so downstream shapes stay one-per-batch across every ref
     and N; this kernel consumes (keys chunk, mask chunk) directly —
     the buffer never round-trips through the host.
+
+    NOT on the production path: sampled_outputs routes device-drawn
+    buffers through _build_ref_kernel_scan only. This form is kept as
+    the scan kernel's single-chunk parity oracle — tests/test_draw.py
+    pins the two bit-identical, which anchors the scan's on-device
+    merge against the simplest possible masked classify.
     """
     check_packed_ratios(nt)
 
@@ -583,8 +589,10 @@ def warmup(
 # older engine are recomputed instead of silently reused — the tag
 # otherwise only captures inputs. v3: flat-space key drawing changed
 # the per-seed sample sets. v4: device-side threefry drawing
-# (cfg.device_draw) changed them again.
-_CHECKPOINT_SCHEMA = 4
+# (cfg.device_draw) changed them again. v5: the 2^46 device-draw bias
+# cap (draw.py::_DEVICE_DRAW_MAX_SPACE) reroutes huge-box refs to the
+# host stream, changing their per-seed sample sets under device_draw.
+_CHECKPOINT_SCHEMA = 5
 
 
 def _use_device_draw(cfg) -> bool:

@@ -87,6 +87,30 @@ def test_over_budget_falls_back_to_host(monkeypatch):
     assert sum(r.n_samples for r in results) > 0
 
 
+def test_bias_bound_routes_huge_spaces_to_host():
+    """plan_draw declines boxes at/above _DEVICE_DRAW_MAX_SPACE (2^46):
+    randint's modulo bias there would exceed the documented 2^-18
+    relative bound, so those refs take the unbiased host numpy draw."""
+    from pluss_sampler_optimization_tpu.models import gemm as gemm_model
+
+    cfg = SamplerConfig(ratio=1e-9, seed=0, device_draw=True)
+
+    def deep_ref(nt):
+        for j in range(nt.tables.n_refs):
+            if int(nt.tables.ref_levels[j]) == 2:
+                return j
+        raise AssertionError("no depth-3 ref")
+
+    # N=65536 depth-3 refs: box ~ (N-1)^3 ~ 2^48 >= 2^46 -> declined
+    nt = ProgramTrace(gemm_model(65536), MACHINE).nests[0]
+    assert D.plan_draw(nt, deep_ref(nt), cfg, 1 << 14) is None
+    # well under the cap: the plan stands
+    nt_small = ProgramTrace(gemm_model(256), MACHINE).nests[0]
+    assert D.plan_draw(
+        nt_small, deep_ref(nt_small), cfg, 1 << 14
+    ) is not None
+
+
 def test_device_and_host_paths_agree_statistically():
     """Same config, both draw paths: MRCs agree to sampling noise."""
     machine = MACHINE

@@ -74,6 +74,29 @@ def test_sampled_sharded_device_draw_nondividing_mesh_raises():
         run_sampled_sharded(gemm(16), MACHINE, cfg, build_mesh(3))
 
 
+def test_sampled_sharded_auto_draw_nondividing_mesh_warns(monkeypatch):
+    """The auto default (device_draw=None) on a non-dividing mesh
+    downgrades to the host draw stream — visibly: a warning flags the
+    cross-engine bit-identity loss instead of a silent divergence.
+    On CPU backends the auto default already resolves to the host
+    stream before the divisibility check, so force the accelerator
+    resolution path by patching the backend probe."""
+    import warnings as _w
+
+    from pluss_sampler_optimization_tpu.parallel import sharded as SH
+
+    cfg = SamplerConfig(ratio=0.25, seed=3, device_draw=None)
+    monkeypatch.setattr(
+        SH, "_use_device_draw",
+        lambda c: True if c.device_draw is None else c.device_draw,
+    )
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        run_sampled_sharded(gemm(16), MACHINE, cfg, build_mesh(3))
+    assert any("downgrades to the host draw" in str(r.message)
+               for r in rec)
+
+
 @pytest.mark.parametrize("n_dev", [2, 8])
 def test_sampled_sharded_device_draw_matches_unsharded(n_dev):
     """Device-drawn samples through the mesh: same threefry stream as
