@@ -121,3 +121,14 @@ def test_bench_emits_json_line():
     reps = doc["extra"]["rep_cpu_wall"]
     assert len(reps) == len(doc["extra"]["engine_s_all"])
     assert all(r["cpu_wall"] > 0 for r in reps)
+    # slow-but-quiet diagnostics (round-3 weak point 1): host identity,
+    # measured speed probe, and compile-cache hit/miss evidence
+    host = doc["extra"]["host"]
+    assert host["speed_probe_s"] > 0
+    assert len(host["cpu_features_hash"]) == 8
+    cc = doc["extra"]["compile_cache"]
+    assert cc["total"]["compile_requests"] >= 0
+    # CPU-fallback runs scope the cache per machine so another host's
+    # AOT executables are never loaded (timing skew + SIGILL hazard)
+    if "device_fallback" in doc["extra"]:
+        assert cc["dir"].endswith(host["cpu_features_hash"])
