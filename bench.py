@@ -536,22 +536,31 @@ def main() -> int:
 
     try:  # persistent cache: repeat driver runs skip recompilation
         cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-        if device_fallback:
-            # CPU executables are machine-specific: scope the cache by
-            # the host's CPU features so this run never loads AOT code
-            # compiled on (or tuned for) another host — observed as
-            # 'machine type ... doesn't match' loader warnings with a
-            # SIGILL caveat, and a silent timing skew candidate for
-            # the round-3 driver-vs-validation spread. The TPU path
-            # keeps the shared dir: its kernels target the chip, not
-            # the host.
-            cache_dir = os.path.join(
-                cache_dir, "cpu-" + _cpu_features_hash()
-            )
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+    def _scope_cache_for_backend(platform: str) -> None:
+        """CPU executables are machine-specific: scope the cache by the
+        host's CPU features so this run never loads AOT code compiled
+        on (or tuned for) another host — the loader only WARNS on a
+        machine-type mismatch ('... could lead to execution errors
+        such as SIGILL') and mismatched codegen silently skews
+        timings, a round-3 spread candidate. Keyed on the CLAIMED
+        backend (not the probe-fallback flag), so probe-disabled runs
+        on CPU-only hosts scope too; the TPU path keeps the shared
+        dir — its kernels target the chip, not the host. Called after
+        the device claim and before the first compile (warm-up)."""
+        if platform == "tpu":
+            return
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(cache_dir, "cpu-" + _cpu_features_hash()),
+            )
+        except Exception:
+            pass
     try:  # compile-cache hit/miss evidence for the bench JSON
         compile_counters = _register_compile_counters()
     except Exception:
@@ -621,6 +630,7 @@ def main() -> int:
     def first_touch():
         stamps["dev"] = jax.devices()[0]
         stamps["init_s"] = time.perf_counter() - t0
+        _scope_cache_for_backend(str(stamps["dev"].platform))
         t1 = time.perf_counter()
         if args.engine == "sampled":
             warmup(prog, machine, cfg)
@@ -874,6 +884,11 @@ def main() -> int:
             extra["second_model"] = sm
         except Exception as e:  # the headline metric must still print
             extra["second_model_error"] = repr(e)
+
+    if compile_counters is not None and "compile_cache" in extra:
+        # final snapshot: the extras (periodic_exact, second model) may
+        # have compiled too; "total" must mean the whole process
+        extra["compile_cache"]["total"] = dict(compile_counters)
 
     print(
         json.dumps(
