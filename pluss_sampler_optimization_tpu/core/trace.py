@@ -62,6 +62,39 @@ class NestTrace:
         self.npost = tuple(
             len(self.nest.refs_at(l, "post")) for l in range(self.nest.depth)
         )
+        # The value overlay: every N-dependent number the TRACED engine
+        # code reads, as arrays. Host engines read the same concrete
+        # numpy defaults (identical numerics); the sampled kernels swap
+        # in traced jnp arrays via with_vals() so one compiled kernel
+        # serves every N with the same structure (sampler/sampled.py::
+        # _kernel_sig). Structure (levels, steps, slots, npre, ...)
+        # always comes from the concrete fields. Built before the
+        # triangular tables: body_at/trip_at read it.
+        t = self.tables
+        self.vals = {
+            "acc": t.acc_per_level,
+            "off": t.ref_offsets,
+            "coeff": t.ref_coeffs,
+            "const": t.ref_consts,
+            "thr": t.ref_share_thresholds,
+            "trips": t.trips,
+            "startb": t.starts,
+            "lc": np.array(
+                [self.schedule.local_count(tt) for tt in range(
+                    self.schedule.threads)],
+                dtype=np.int64,
+            ),
+            "vlo": np.array(
+                [self.level_value_range(l)[0] for l in range(self.nest.depth)]
+                + [0] * (len(t.trips) - self.nest.depth),
+                dtype=np.int64,
+            ),
+            "vhi": np.array(
+                [self.level_value_range(l)[1] for l in range(self.nest.depth)]
+                + [0] * (len(t.trips) - self.nest.depth),
+                dtype=np.int64,
+            ),
+        }
         # Triangular nests (inner bounds affine in the parallel value):
         # body sizes vary per parallel iteration, so the per-thread
         # position bases are prefix sums over the thread's dispatch
@@ -89,14 +122,41 @@ class NestTrace:
         else:
             self.max_trips = tuple(lp.trip for lp in self.nest.loops)
             self.max_body0 = int(self.acc[0])
+        if self.tri:
+            self.vals["tri_base"] = self.tri_base
+
+    def with_vals(self, vals: dict) -> "NestTrace":
+        """Shallow copy with the value overlay swapped (traced arrays
+        inside a jit; concrete arrays otherwise). Structural fields are
+        shared with self and MUST agree with the overlay's provenance —
+        the kernel signature (sampler/sampled.py::_kernel_sig) is the
+        contract that makes a structure-equal trace's values safe here."""
+        import copy
+
+        c = copy.copy(self)
+        c.vals = vals
+        return c
 
     @property
     def acc(self) -> np.ndarray:
         return self.tables.acc_per_level
 
     def trip_at(self, level: int, v0):
-        """Level trip count at parallel value v0 (elementwise)."""
-        return self.nest.loops[level].trip_at(v0)
+        """Level trip count at parallel value v0 (elementwise).
+
+        Reads the trip base from the value overlay so traced kernels
+        stay N-generic; the affine coefficient is structural."""
+        tc = int(self.tables.trip_coeffs[level])
+        base = self.vals["trips"][level]
+        if tc == 0:
+            return v0 * 0 + base
+        return (base + tc * v0).clip(0)
+
+    def start_at(self, level: int, v0):
+        """First iteration value of a level at parallel value v0
+        (elementwise; overlay-aware twin of Loop.start_at)."""
+        sc = int(self.tables.start_coeffs[level])
+        return self.vals["startb"][level] + sc * v0
 
     def body_at(self, level: int, v0):
         """Accesses of ONE full level-`level` iteration at parallel
@@ -159,33 +219,37 @@ class NestTrace:
             return int(self.tri_base[tid, self.schedule.local_count(tid)])
         return self.schedule.local_count(tid) * int(self.acc[0])
 
-    def access_position(self, ref_idx: int, m, n1=0, n2=0):
+    def access_position(self, ref_idx: int, m, n1=0, n2=0, rx=None):
         """Thread-local position of one access; elementwise over arrays.
 
         `m` is the thread-local parallel-iteration index; n1/n2 are
         normalized inner-loop indices (ignored beyond the ref's level).
         Rectangular nests only — triangular positions need the
-        per-thread base table (tri_position).
+        per-thread base table (tri_position). `rx` (default ref_idx)
+        is the index used for VALUE lookups — a traced scalar in the
+        shared sampled kernels, letting structurally identical refs
+        (same level/array) reuse one compile while their offsets ride
+        in as operands; ref_idx always supplies the static structure.
         """
         if self.tri:
             raise NotImplementedError(
                 "access_position is undefined for triangular nests; "
                 "use tri_position with tri_base"
             )
-        t = self.tables
-        level = int(t.ref_levels[ref_idx])
-        p = m * int(t.acc_per_level[0]) + int(t.ref_offsets[ref_idx])
+        rx = ref_idx if rx is None else rx
+        level = int(self.tables.ref_levels[ref_idx])
+        acc = self.vals["acc"]
+        p = m * acc[0] + self.vals["off"][rx]
         if level >= 1:
-            p = p + self.npre[0] + n1 * int(t.acc_per_level[1])
+            p = p + self.npre[0] + n1 * acc[1]
         if level >= 2:
-            p = p + self.npre[1] + n2 * int(t.acc_per_level[2])
+            p = p + self.npre[1] + n2 * acc[2]
         return p
 
     def ref_flat(self, ref_idx: int, v0, v1=0, v2=0):
         """Affine flat element index from loop *values* (not normalized)."""
-        t = self.tables
-        c = t.ref_coeffs[ref_idx]
-        return v0 * int(c[0]) + v1 * int(c[1]) + v2 * int(c[2]) + int(t.ref_consts[ref_idx])
+        c = self.vals["coeff"][ref_idx]
+        return v0 * c[0] + v1 * c[1] + v2 * c[2] + self.vals["const"][ref_idx]
 
     def ref_addr(self, ref_idx: int, v0, v1=0, v2=0):
         """Cache-line address: flat*DS//CLS (GetAddress_*, ...ri-omp-seq.cpp:12-35)."""

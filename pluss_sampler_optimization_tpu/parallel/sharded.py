@@ -55,6 +55,8 @@ from ..sampler.sampled import (
     default_batch,
     DEFAULT_CAPACITY,
     SampledRefResult,
+    _kernel_sig,
+    _pad_highs,
     _use_device_draw,
     check_packed_ratios,
     classify_samples,
@@ -92,10 +94,13 @@ def _build_sharded_ref_kernel(
     else:
         _hist_fn = exp_hist
 
-    def _classify(sample_keys, w, highs):
+    def _classify(sample_keys, w, highs, vals, rx):
         """Shared per-slice body: classify + the three local outputs."""
+        snt = nt.with_vals(vals)
         samples = decode_sample_keys(sample_keys, highs)
-        packed, ri, is_share, found = classify_samples(nt, ref_idx, samples)
+        packed, ri, is_share, found = classify_samples(
+            snt, ref_idx, samples, rx
+        )
         nosh = _hist_fn(jnp.maximum(ri, 1), (found & ~is_share & w))
         cold = jnp.sum((~found & w).astype(jnp.int64))
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
@@ -116,14 +121,14 @@ def _build_sharded_ref_kernel(
         )
 
     if scan:
-        def local_fn(sample_keys, mask, highs, n_chunks):
+        def local_fn(sample_keys, mask, highs, vals, rx, n_chunks):
             kb = sample_keys.reshape(n_chunks, -1)
             mb = mask.reshape(n_chunks, -1)
 
             def step(carry, xm):
                 ck, cc, cold, max_nu, nh = carry
                 x, msk = xm
-                nosh, c, k2, c2, nu = _classify(x, msk, highs)
+                nosh, c, k2, c2, nu = _classify(x, msk, highs, vals, rx)
                 mk, mc, mnu = merge_pair_sets(ck, cc, k2, c2, capacity)
                 return (
                     mk, mc, cold + c,
@@ -143,39 +148,68 @@ def _build_sharded_ref_kernel(
             )
             return _mesh_reduce(nh, cold, mk, mc, max_nu)
 
-        def entry(sample_keys, mask, highs: tuple, n_chunks: int):
+        def entry(sample_keys, mask, highs, vals, rx, n_chunks: int):
             return jax.shard_map(
-                functools.partial(
-                    local_fn, highs=highs, n_chunks=n_chunks
-                ),
+                functools.partial(local_fn, n_chunks=n_chunks),
                 mesh=mesh,
-                in_specs=(P(axis), P(axis)),
+                in_specs=(P(axis), P(axis), P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P()),
                 # all_gather outputs ARE replicated, but the static
                 # varying-axes check cannot infer that
                 check_vma=False,
-            )(sample_keys, mask)
+            )(sample_keys, mask, highs, vals, rx)
 
-        return jax.jit(entry, static_argnames=("highs", "n_chunks"))
+        return jax.jit(entry, static_argnames=("n_chunks",))
 
-    def local_fn(sample_keys, n_valid, highs):
+    def local_fn(sample_keys, n_valid, highs, vals, rx):
         # int64 mixed-radix keys on the wire (8 bytes/sample); decode
         # and the padding weight mask both happen device-side
         local_b = sample_keys.shape[0]
         base = jax.lax.axis_index(axis).astype(jnp.int64) * local_b
         w = base + jnp.arange(local_b, dtype=jnp.int64) < n_valid
-        return _mesh_reduce(*_classify(sample_keys, w, highs))
+        return _mesh_reduce(*_classify(sample_keys, w, highs, vals, rx))
 
-    def entry(sample_keys, n_valid, highs: tuple):
+    def entry(sample_keys, n_valid, highs, vals, rx):
         return jax.shard_map(
-            functools.partial(local_fn, highs=highs),
+            local_fn,
             mesh=mesh,
-            in_specs=(P(axis), P()),
+            in_specs=(P(axis), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
-        )(sample_keys, n_valid)
+        )(sample_keys, n_valid, highs, vals, rx)
 
-    return jax.jit(entry, static_argnames=("highs",))
+    return jax.jit(entry)
+
+
+# (sig, mesh, capacity, pallas, scan) -> shared jitted kernel; same
+# sharing rule as sampler/sampled.py::_SIG_KERNELS — structure in the
+# closure, every N-dependent number in the highs/vals operands.
+# Bounded LRU: closures pin a NestTrace + executables, and capacity
+# regrows mint additional entries.
+import collections as _collections
+
+_SHARDED_SIG_KERNELS: "_collections.OrderedDict" = _collections.OrderedDict()
+_SHARDED_SIG_KERNELS_MAX = 32
+
+
+def _sharded_kernels_for(
+    nt: NestTrace, ref_idx: int, mesh, capacity: int,
+    use_pallas_hist: bool, scan: bool,
+):
+    key = (
+        _kernel_sig(nt, ref_idx), mesh, capacity, use_pallas_hist, scan,
+    )
+    kern = _SHARDED_SIG_KERNELS.get(key)
+    if kern is None:
+        kern = _build_sharded_ref_kernel(
+            nt, ref_idx, mesh, capacity, use_pallas_hist, scan
+        )
+        _SHARDED_SIG_KERNELS[key] = kern
+        while len(_SHARDED_SIG_KERNELS) > _SHARDED_SIG_KERNELS_MAX:
+            _SHARDED_SIG_KERNELS.popitem(last=False)
+    else:
+        _SHARDED_SIG_KERNELS.move_to_end(key)
+    return kern
 
 
 @functools.lru_cache(maxsize=16)
@@ -199,7 +233,7 @@ def _sharded_program_kernels(
         for ri in range(nt.tables.n_refs):
             kernels.append(
                 [k, ri,
-                 _build_sharded_ref_kernel(
+                 _sharded_kernels_for(
                      nt, ri, mesh, capacity, use_pallas_hist, scan
                  ),
                  capacity]  # capacity travels with the kernel: a
@@ -343,16 +377,17 @@ def sampled_outputs_sharded(
                 (B,), in_sharding, pieces
             )
 
+        ph = _pad_highs(highs)
+        rxv = np.int64(ri)
         if drawn is not None:
             n_chunks = dev_keys.shape[0] // batch
             kc = _buffer_to_global(dev_keys)
             mc = _buffer_to_global(dev_mask)
             dispatch(
                 scan_kernels[idx],
-                lambda kern, kc=kc, mc=mc, nc=n_chunks: kern(
-                    kc, mc, tuple(highs), nc
-                ),
-                lambda c2, nt=nt, ri=ri: _build_sharded_ref_kernel(
+                lambda kern, kc=kc, mc=mc, nc=n_chunks, ph=ph,
+                nv=nt.vals, rxv=rxv: kern(kc, mc, ph, nv, rxv, nc),
+                lambda c2, nt=nt, ri=ri: _sharded_kernels_for(
                     nt, ri, mesh, c2, cfg.use_pallas_hist, scan=True
                 ),
             )
@@ -376,11 +411,10 @@ def sampled_outputs_sharded(
                 )
                 dispatch(
                     kernels[idx],
-                    lambda kern, cj=cj, n_valid=n_valid: kern(
-                        cj, n_valid, tuple(highs)
-                    ),
-                    lambda c2, nt=nt, ri=ri: _build_sharded_ref_kernel(
-                        nt, ri, mesh, c2, cfg.use_pallas_hist
+                    lambda kern, cj=cj, n_valid=n_valid, ph=ph,
+                    nv=nt.vals, rxv=rxv: kern(cj, n_valid, ph, nv, rxv),
+                    lambda c2, nt=nt, ri=ri: _sharded_kernels_for(
+                        nt, ri, mesh, c2, cfg.use_pallas_hist, scan=False
                     ),
                 )
         results.append(

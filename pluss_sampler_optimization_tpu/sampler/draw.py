@@ -71,9 +71,18 @@ _DEVICE_DRAW_MAX_SPACE = 1 << 46
 
 
 def bucket_size(m: int, batch: int) -> int:
-    """Round the candidate count up to a multiple of the dispatch
-    batch (so chunk shapes are shared) with at least one batch."""
-    return max(batch, -(-m // batch) * batch)
+    """Round the candidate count up to batch * 2^k with at least one
+    batch. Geometric bucketing (round 5; previously any batch multiple)
+    caps the number of distinct buffer shapes at ~log2(max/batch), so
+    the scan-fused classify kernels — compiled per (structure, n_chunks)
+    — and this module's draw kernels stay within a handful of compiles
+    across every model and N instead of one per (ref, N). Costs at most
+    2x padded draw compute, which is noise next to a single kernel
+    compile through the tunneled AOT helper (~1-1.5 min)."""
+    n_chunks = 1
+    while n_chunks * batch < m:
+        n_chunks *= 2
+    return n_chunks * batch
 
 
 def plan_draw(nt, ref_idx: int, cfg, batch: int):

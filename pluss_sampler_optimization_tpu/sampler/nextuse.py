@@ -125,8 +125,8 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
     """
     t = nt.tables
     lv = int(t.ref_levels[ref_idx])
-    off = int(t.ref_offsets[ref_idx])
-    a0 = int(t.acc_per_level[0])
+    off = nt.vals["off"][ref_idx]
+    a0 = nt.vals["acc"][0]
     np0, np1 = nt.npre[0], (nt.npre[1] if nt.nest.depth > 1 else 0)
 
     m0 = p0 // a0
@@ -135,9 +135,9 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
     def pos(m, n1=None, n2=None):
         p = m * a0 + off
         if lv >= 1:
-            p = p + np0 + n1 * int(t.acc_per_level[1])
+            p = p + np0 + n1 * nt.vals["acc"][1]
         if lv >= 2:
-            p = p + np1 + n2 * int(t.acc_per_level[2])
+            p = p + np1 + n2 * nt.vals["acc"][2]
         return p
 
     def guard(p, *parts):
@@ -156,7 +156,7 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
         cands.append(jnp.where(pB > p0, pB, INF))
         return jnp.minimum(*cands) if len(cands) > 1 else cands[0]
 
-    a1 = int(t.acc_per_level[1])
+    a1 = nt.vals["acc"][1]
     j0 = (r0 - np0) // a1
     rr0 = r0 - np0 - j0 * a1
 
@@ -172,7 +172,7 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
         pC = guard(pos(mC, n1C), mC, n1C)
         cands.append(jnp.where(pC > p0, pC, INF))
     else:
-        a2 = int(t.acc_per_level[2])
+        a2 = nt.vals["acc"][2]
         mA = specs[0].min_gt(m0)
         n1A = specs[1].min_val()
         n2A = specs[2].min_val()
@@ -201,8 +201,10 @@ def min_position_after(nt: NestTrace, ref_idx: int, p0, specs):
 _MAX_BAND_CANDIDATES = 128
 
 
-def _ref_vars(nt: NestTrace, ref_idx: int):
-    """Nonzero (level, coeff) terms of a ref's flat map, coeff descending.
+def _ref_vars_static(nt: NestTrace, ref_idx: int):
+    """Nonzero (level, concrete coeff) terms of a ref's flat map, coeff
+    descending — the STRUCTURE of the band enumeration (the traced math
+    reads the coefficient values from nt.vals).
 
     The row-major PolyBench family always yields positive coefficients
     (strides n^2, n, 1 ...); negative strides have no closed-form band
@@ -218,48 +220,38 @@ def _ref_vars(nt: NestTrace, ref_idx: int):
                 f"ref {t.ref_names[ref_idx]}: negative stride unsupported"
             )
     nz.sort(key=lambda p: -p[1])
-    return nz, int(t.ref_consts[ref_idx])
+    return nz
 
 
-def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
-    """Enumerate level-value assignments whose flat map lands in the
-    band [lo, lo+W), recursively largest stride first: each head value
-    divides the residual band, the innermost unit-stride variable takes
-    an exact W-wide window (one value-space interval where the level
-    permits, W per-value candidates otherwise), and a trailing band
-    check covers every other terminal. The candidate count is a static
-    O(1) bound per level. Shared by the rectangular and triangular
-    solvers; `emit(fixed_vals, ok)` receives value-space encodings
-    {level: ("fixval", u) | ("interval", va, vb)}.
+def band_plan(nt: NestTrace, sink_idx: int, W: int) -> tuple:
+    """The static shape of one ref's band enumeration, from CONCRETE
+    trace values: a nested tuple of nodes
+
+      ("head", level, n_u, child)   enumerate n_u head-variable values
+      ("interval", level)           unit-stride terminal, one interval
+      ("window", level, W)          unit-stride terminal, W fixed values
+      ("check",)                    constant-terminal band check
+
+    _band_candidates follows this plan with traced math, so the plan is
+    exactly the part of the enumeration that a compiled kernel bakes
+    in — it is the band component of the kernel signature
+    (sampler/sampled.py::_kernel_sig): two traces with equal plans (and
+    equal structural tables) can share one compiled kernel, with every
+    numeric difference riding in as operands.
     """
-    nz, d = _ref_vars(nt, sink_idx)
-    lo = lo - d
+    nz = _ref_vars_static(nt, sink_idx)
 
-    def value_span(l):
-        return nt.level_value_range(l)
-
-    def recurse(vars_left, lo_cur, ok, fixed_vals):
+    def node(vars_left):
         if not vars_left:
-            # remaining contribution is 0: valid iff 0 in [lo_cur, lo_cur+W)
-            emit(fixed_vals, ok & (lo_cur <= 0) & (lo_cur > -W))
-            return
+            return ("check",)
         if len(vars_left) == 1 and vars_left[0][1] == 1:
             l, _ = vars_left[0]
             if l != 0 and nt.nest.loops[l].step == 1:
-                # one contiguous interval replaces W per-value
-                # candidates (band membership by construction); level 0
-                # is excluded because thread ownership chops its range
-                emit({**fixed_vals, l: ("interval", lo_cur, lo_cur + W)},
-                     ok)
-                return
-            for k in range(W):  # exact window
-                emit({**fixed_vals, l: ("fixval", lo_cur + k)}, ok)
-            return
+                return ("interval", l)
+            return ("window", l, W)
         (l, c), rest = vars_left[0], vars_left[1:]
-        r_min = sum(cr * value_span(lr)[0] for lr, cr in rest)
-        r_max = sum(cr * value_span(lr)[1] for lr, cr in rest)
-        u_min = _cdiv(lo_cur - r_max, c)
-        u_max = (lo_cur + W - 1 - r_min) // c
+        r_min = sum(cr * nt.level_value_range(lr)[0] for lr, cr in rest)
+        r_max = sum(cr * nt.level_value_range(lr)[1] for lr, cr in rest)
         n_u = (W - 1 + (r_max - r_min)) // c + 2  # static bound
         if n_u > _MAX_BAND_CANDIDATES:
             # O(1) only holds when the head coefficient dominates the
@@ -267,7 +259,7 @@ def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
             # n^2 > n > 1). Two comparable coefficients (e.g. flat =
             # i + j) would make n_u O(trip), silently unrolling
             # thousands of emit() calls into the traced graph; fail
-            # fast like the negative-stride gate in _ref_vars instead.
+            # fast like the negative-stride gate instead.
             raise NotImplementedError(
                 f"ref {nt.tables.ref_names[sink_idx]}: head stride {c} "
                 f"does not dominate the residual span "
@@ -275,12 +267,60 @@ def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
                 f"{_MAX_BAND_CANDIDATES}); no O(1) closed-form band "
                 "enumeration for this flat map"
             )
+        return ("head", l, n_u, node(rest))
+
+    return node(nz)
+
+
+def _band_candidates(nt: NestTrace, sink_idx: int, lo, W: int, true_, emit):
+    """Enumerate level-value assignments whose flat map lands in the
+    band [lo, lo+W), following band_plan's static structure: each head
+    value divides the residual band, the innermost unit-stride variable
+    takes an exact W-wide window (one value-space interval where the
+    level permits, W per-value candidates otherwise), and a trailing
+    band check covers every other terminal. All numeric inputs (coeffs,
+    const, value spans) come from nt.vals, so the emitted graph is
+    N-generic under with_vals. Shared by the rectangular and triangular
+    solvers; `emit(fixed_vals, ok)` receives value-space encodings
+    {level: ("fixval", u) | ("interval", va, vb)}.
+    """
+    plan = band_plan(nt, sink_idx, W)
+    nz = _ref_vars_static(nt, sink_idx)
+    coeff_v = {l: nt.vals["coeff"][sink_idx][l] for l, _ in nz}
+    lo = lo - nt.vals["const"][sink_idx]
+    vlo_v, vhi_v = nt.vals["vlo"], nt.vals["vhi"]
+
+    def follow(pnode, vars_left, lo_cur, ok, fixed_vals):
+        kind = pnode[0]
+        if kind == "check":
+            # remaining contribution is 0: valid iff 0 in [lo_cur, lo_cur+W)
+            emit(fixed_vals, ok & (lo_cur <= 0) & (lo_cur > -W))
+            return
+        if kind == "interval":
+            l = pnode[1]
+            # one contiguous interval replaces W per-value candidates
+            # (band membership by construction); level 0 is excluded
+            # because thread ownership chops its range
+            emit({**fixed_vals, l: ("interval", lo_cur, lo_cur + W)}, ok)
+            return
+        if kind == "window":
+            l = pnode[1]
+            for k in range(pnode[2]):  # exact window
+                emit({**fixed_vals, l: ("fixval", lo_cur + k)}, ok)
+            return
+        _, l, n_u, child = pnode
+        cv = coeff_v[l]
+        rest = vars_left[1:]
+        r_min = sum(coeff_v[lr] * vlo_v[lr] for lr, _ in rest)
+        r_max = sum(coeff_v[lr] * vhi_v[lr] for lr, _ in rest)
+        u_min = _cdiv(lo_cur - r_max, cv)
+        u_max = (lo_cur + W - 1 - r_min) // cv
         for iu in range(n_u):
             u = u_min + iu
-            recurse(rest, lo_cur - c * u, ok & (u <= u_max),
-                    {**fixed_vals, l: ("fixval", u)})
+            follow(child, rest, lo_cur - cv * u, ok & (u <= u_max),
+                   {**fixed_vals, l: ("fixval", u)})
 
-    recurse(nz, lo, true_, {})
+    follow(plan, nz, lo, true_, {})
 
 
 def next_use_candidates_group(
@@ -304,20 +344,19 @@ def next_use_candidates_group(
     W = machine.lines_per_element_block
 
     # per-sample local-count bound for free level 0
-    local_counts = jnp.array(
-        [sched.local_count(tt) for tt in range(sched.threads)], dtype=jnp.int64
-    )
+    local_counts = jnp.asarray(nt.vals["lc"])
     l_bound = local_counts[tid]
+    trips_v = nt.vals["trips"]
 
     def level_bound(l):
-        return l_bound if l == 0 else jnp.int64(nt.nest.loops[l].trip)
+        return l_bound if l == 0 else trips_v[l]
 
     def spec_from_value(l, value, extra_valid):
         """Fix level l to loop *value* `value` (normalize + validate)."""
         lp = nt.nest.loops[l]
         n = (value - lp.start) // lp.step
         ok = extra_valid & ((value - lp.start) % lp.step == 0)
-        ok = ok & (n >= 0) & (n < lp.trip)
+        ok = ok & (n >= 0) & (n < trips_v[l])
         if l == 0:
             ok = ok & (sched.owner_tid(n) == tid)
             return _LevelSpec.fix(sched.local_index(n), ok)
@@ -333,7 +372,7 @@ def next_use_candidates_group(
                     lp = nt.nest.loops[l]
                     _, va, vb = fixed_vals[l]
                     n_lo = jnp.maximum(va - lp.start, 0)
-                    n_hi = jnp.minimum(vb - lp.start, lp.trip)
+                    n_hi = jnp.minimum(vb - lp.start, trips_v[l])
                     specs.append(_LevelSpec.interval(
                         n_lo, jnp.where(ok, n_hi, n_lo)
                     ))
@@ -397,14 +436,11 @@ def next_use_candidates_tri_group(
     lv = int(t.ref_levels[sink_idx])
     W = machine.lines_per_element_block
 
-    lmax = sched.max_local_count()
-    base_tab = jnp.asarray(nt.tri_base)
-    local_counts = jnp.array(
-        [sched.local_count(tt) for tt in range(sched.threads)],
-        dtype=jnp.int64,
-    )
+    base_tab = jnp.asarray(nt.vals["tri_base"])
+    lmax = base_tab.shape[1] - 1  # == sched.max_local_count(), static
+    local_counts = jnp.asarray(nt.vals["lc"])
     l_count = local_counts[tid]
-    start0, trip0 = nest.loops[0].start, nest.loops[0].trip
+    start0, trip0 = nest.loops[0].start, nt.vals["trips"][0]
     np0 = nt.npre[0]
     np1 = nt.npre[1] if nest.depth > 1 else 0
     a2 = (
@@ -419,18 +455,17 @@ def next_use_candidates_tri_group(
 
     def dom_bounds(l, dom, v0m):
         """Half-open index interval [lo, hi) of domain `dom` at v0m."""
-        lp = nest.loops[l]
-        tripv = lp.trip_at(v0m)
+        tripv = nt.trip_at(l, v0m)
         if dom is None:  # free
             return jnp.zeros_like(tripv), tripv
         kind = dom[0]
         if kind == "fixval":
-            n = dom[1] - lp.start_at(v0m)
+            n = dom[1] - nt.start_at(l, v0m)
             ok = (n >= 0) & (n < tripv)
             return n, jnp.where(ok, n + 1, n)
         va, vb = dom[1], dom[2]  # value-space interval [va, vb)
-        lo_i = jnp.maximum(va - lp.start_at(v0m), 0)
-        hi_i = jnp.minimum(vb - lp.start_at(v0m), tripv)
+        lo_i = jnp.maximum(va - nt.start_at(l, v0m), 0)
+        hi_i = jnp.minimum(vb - nt.start_at(l, v0m), tripv)
         return lo_i, jnp.maximum(hi_i, lo_i)
 
     def min_inner_pos(doms, v0m, basem, okm, j):
@@ -468,8 +503,9 @@ def next_use_candidates_tri_group(
         Each inner domain is nonempty over an affine v0 halfspace
         intersection; the minimal valid m' is a count_below query.
         """
-        vlo = jnp.full(jnp.shape(p0), start0, dtype=jnp.int64)
-        vhi = jnp.full(jnp.shape(p0), start0 + trip0 - 1, dtype=jnp.int64)
+        z = jnp.zeros(jnp.shape(p0), dtype=jnp.int64)
+        vlo = z + start0
+        vhi = z + start0 + trip0 - 1
         okc = ok
 
         def add(a, b):
@@ -485,8 +521,8 @@ def next_use_candidates_tri_group(
 
         for l in range(1, lv + 1):
             lp = nest.loops[l]
-            s, sc = lp.start, lp.start_coeff
-            tr, tc = lp.trip, lp.trip_coeff
+            s, sc = nt.vals["startb"][l], lp.start_coeff
+            tr, tc = nt.vals["trips"][l], lp.trip_coeff
             dom = doms.get(l)
             if dom is None:
                 add(tc, tr - 1)  # trip(v0) >= 1

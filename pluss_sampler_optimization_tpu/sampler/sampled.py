@@ -248,7 +248,7 @@ def check_packed_ratios(nt: NestTrace) -> None:
             )
 
 
-def classify_samples(nt: NestTrace, ref_idx: int, samples):
+def classify_samples(nt: NestTrace, ref_idx: int, samples, rx=None):
     """Per-sample reuse classification (traced JAX math).
 
     Returns (packed, ri, is_share, found): the packed
@@ -257,13 +257,15 @@ def classify_samples(nt: NestTrace, ref_idx: int, samples):
     (...ri-omp-seq.cpp:203-207) and the found mask (False = the line is
     never touched again, the -1 flush case, r10 :671). Single source of
     truth for both the single-device and the mesh-sharded kernels.
+    `rx` (default ref_idx) is the VALUE-lookup index — a traced operand
+    in the shared kernels (see access_position's rx doc).
     """
     t = nt.tables
-    tid, p0, line, m0 = _sample_geometry(nt, ref_idx, samples)
+    tid, p0, line, m0 = _sample_geometry(nt, ref_idx, samples, rx)
     best, best_sink = _best_sink(nt, ref_idx, tid, p0, line, m0)
     found = best < INF
     ri = jnp.where(found, best - p0, 0)
-    thr = jnp.array(t.ref_share_thresholds, dtype=jnp.int64)[best_sink]
+    thr = jnp.asarray(nt.vals["thr"])[best_sink]
     ratio = jnp.array(t.ref_share_ratios, dtype=jnp.int64)[best_sink]
     is_share = found & (thr > 0) & (jnp.abs(ri) > jnp.abs(ri - thr))
     slot = jnp.where(is_share, ratio, _NOSHARE_SLOT)
@@ -305,6 +307,123 @@ def decode_pairs(keys, counts, noshare: dict, share: dict) -> None:
             h[ri_val] = h.get(ri_val, 0.0) + cnt
 
 
+def _pad_highs(highs) -> np.ndarray:
+    """Mixed-radix highs padded to MAX_DEPTH with 1s, as an int64
+    operand vector: the padded divmods are no-ops (col 0), so one
+    compiled decode serves every ref depth and every N."""
+    from ..ir import MAX_DEPTH
+
+    out = np.ones(MAX_DEPTH, dtype=np.int64)
+    out[: len(highs)] = list(highs)
+    return out
+
+
+def _kernel_sig(nt: NestTrace, ref_idx: int) -> tuple:
+    """Everything a compiled kernel bakes in as STRUCTURE, as a hashable
+    key. Two (nest, ref) pairs with equal signatures can share one
+    compiled kernel — all remaining numeric differences (trips, coeffs,
+    consts, thresholds, offsets, ...) ride in through the nt.vals
+    operand pytree. In practice the signature is N-invariant (for N
+    large enough that the band plans stabilize, N >= ~2 cache lines per
+    row), so GEMM at N=256 and N=4096 share kernels, and structurally
+    identical refs (e.g. the read and write halves of `C[i][j] +=`)
+    collapse to one compile.
+
+    The rule that keeps this safe: every concrete value the traced code
+    reads from the nest (rather than from nt.vals) MUST appear here —
+    loop starts/steps, affine structure coefficients, npre/npost, the
+    schedule's static fields, machine geometry, the _best_sink group
+    partition and each group's band plan (sampler/nextuse.py::band_plan).
+    """
+    from .nextuse import band_plan
+
+    t = nt.tables
+    m = nt.machine
+    sched = nt.schedule
+    W = m.lines_per_element_block
+    plans = tuple(
+        (tuple(sinks), band_plan(nt, sinks[0], W))
+        for sinks in _sink_groups(nt, ref_idx)
+    )
+    return (
+        # source structural key: level + array (the value index rides
+        # in as the traced rx operand, so e.g. C[i][j]'s read and write
+        # halves share one compile); triangular sources keep their
+        # exact index — tri_position reads structural slot offsets
+        (
+            int(t.ref_levels[ref_idx]),
+            int(t.ref_arrays[ref_idx]),
+            ref_idx if nt.tri else None,
+        ),
+        nt.tri,
+        int(t.depth),
+        nt.npre,
+        nt.npost,
+        tuple(int(x) for x in t.ref_levels),
+        tuple(int(x) for x in t.ref_arrays),
+        tuple(int(x) for x in t.ref_share_ratios),
+        tuple(r.slot for r in nt.nest.refs),
+        tuple(int(x) for x in t.steps),
+        tuple(int(x) for x in t.starts),
+        tuple(int(x) for x in t.trip_coeffs),
+        tuple(int(x) for x in t.start_coeffs),
+        (m.thread_num, m.chunk_size, m.ds, m.cls),
+        (sched.chunk, sched.threads, sched.start, sched.step),
+        nt.tri_base.shape if nt.tri else None,
+        plans,
+    )
+
+
+def _sink_groups(nt: NestTrace, ref_idx: int) -> list:
+    """Same-array sink refs partitioned by identical flat map
+    ((level, coeffs, const) equality), in first-seen order.
+
+    SINGLE source of truth for both _best_sink (the traced group
+    solve) and _kernel_sig (the sharing key): kernel-sharing soundness
+    requires the signature to capture exactly the partition the traced
+    code uses, so they must never diverge."""
+    t = nt.tables
+    groups: dict[tuple, list[int]] = {}
+    for j in range(t.n_refs):
+        if t.ref_arrays[j] != t.ref_arrays[ref_idx]:
+            continue
+        key = (
+            int(t.ref_levels[j]),
+            tuple(int(c) for c in t.ref_coeffs[j]),
+            int(t.ref_consts[j]),
+        )
+        groups.setdefault(key, []).append(j)
+    return list(groups.values())
+
+
+# signature -> {"plain": ..., "scan": ..., "masked": ...} jitted kernels.
+# The closures hold the FIRST trace that produced the signature, for
+# structure only; values always arrive through the vals operand.
+# Bounded LRU: each closure pins a whole NestTrace (incl. tri_base at
+# triangular N) plus compiled executables for process lifetime.
+import collections as _collections
+
+_SIG_KERNELS: "_collections.OrderedDict" = _collections.OrderedDict()
+_SIG_KERNELS_MAX = 64
+
+
+def _kernels_for(nt: NestTrace, ref_idx: int) -> dict:
+    sig = _kernel_sig(nt, ref_idx)
+    entry = _SIG_KERNELS.get(sig)
+    if entry is None:
+        entry = {
+            "plain": _build_ref_kernel(nt, ref_idx),
+            "scan": _build_ref_kernel_scan(nt, ref_idx),
+            "masked": _build_ref_kernel_masked(nt, ref_idx),
+        }
+        _SIG_KERNELS[sig] = entry
+        while len(_SIG_KERNELS) > _SIG_KERNELS_MAX:
+            _SIG_KERNELS.popitem(last=False)
+    else:
+        _SIG_KERNELS.move_to_end(sig)
+    return entry
+
+
 def _build_ref_kernel(nt: NestTrace, ref_idx: int):
     """jitted (sample keys, valid count) -> packed unique pairs + cold.
 
@@ -312,14 +431,18 @@ def _build_ref_kernel(nt: NestTrace, ref_idx: int):
     minimal wire format (the host->device link crosses a network tunnel
     when the TPU is remote) — and are decoded by the device's divmod
     chain; the padding weight mask is likewise reconstructed on device
-    from the valid count.
+    from the valid count. `highs` (padded to MAX_DEPTH) and `vals` (the
+    trace's value overlay) are device operands, so one compile serves
+    every N and every structurally identical ref (round-4 verdict: the
+    per-(ref, N) cold-compile tax through the tunneled AOT helper).
     """
     check_packed_ratios(nt)
 
-    @functools.partial(jax.jit, static_argnames=("highs", "capacity"))
-    def kernel(sample_keys, n_valid, highs: tuple, capacity: int):
+    @functools.partial(jax.jit, static_argnames=("capacity",))
+    def kernel(sample_keys, n_valid, highs, vals, rx, capacity: int):
+        snt = nt.with_vals(vals)
         samples = decode_sample_keys(jnp.asarray(sample_keys), highs)
-        packed, _, _, found = classify_samples(nt, ref_idx, samples)
+        packed, _, _, found = classify_samples(snt, ref_idx, samples, rx)
         w = jnp.arange(sample_keys.shape[0], dtype=jnp.int64) < n_valid
         keys, counts, n_unique = fixed_k_unique(packed, found & w, capacity)
         cold = jnp.sum((~found & w).astype(jnp.int64))
@@ -348,9 +471,11 @@ def _build_ref_kernel_scan(nt: NestTrace, ref_idx: int):
     check_packed_ratios(nt)
 
     @functools.partial(
-        jax.jit, static_argnames=("highs", "capacity", "n_chunks")
+        jax.jit, static_argnames=("capacity", "n_chunks")
     )
-    def kernel(keys_B, mask_B, highs: tuple, capacity: int, n_chunks: int):
+    def kernel(keys_B, mask_B, highs, vals, rx, capacity: int,
+               n_chunks: int):
+        snt = nt.with_vals(vals)
         kb = keys_B.reshape(n_chunks, -1)
         mb = mask_B.reshape(n_chunks, -1)
 
@@ -358,7 +483,7 @@ def _build_ref_kernel_scan(nt: NestTrace, ref_idx: int):
             ck, cc, cold, max_nu = carry
             x, msk = xm
             samples = decode_sample_keys(x, highs)
-            packed, _, _, found = classify_samples(nt, ref_idx, samples)
+            packed, _, _, found = classify_samples(snt, ref_idx, samples, rx)
             k2, c2, nu = fixed_k_unique(packed, found & msk, capacity)
             mk, mc, mnu = merge_pair_sets(ck, cc, k2, c2, capacity)
             cold = cold + jnp.sum((~found & msk).astype(jnp.int64))
@@ -394,10 +519,11 @@ def _build_ref_kernel_masked(nt: NestTrace, ref_idx: int):
     """
     check_packed_ratios(nt)
 
-    @functools.partial(jax.jit, static_argnames=("highs", "capacity"))
-    def kernel(sample_keys, mask, highs: tuple, capacity: int):
+    @functools.partial(jax.jit, static_argnames=("capacity",))
+    def kernel(sample_keys, mask, highs, vals, rx, capacity: int):
+        snt = nt.with_vals(vals)
         samples = decode_sample_keys(sample_keys, highs)
-        packed, _, _, found = classify_samples(nt, ref_idx, samples)
+        packed, _, _, found = classify_samples(snt, ref_idx, samples, rx)
         keys, counts, n_unique = fixed_k_unique(
             packed, found & mask, capacity
         )
@@ -407,32 +533,40 @@ def _build_ref_kernel_masked(nt: NestTrace, ref_idx: int):
     return kernel
 
 
-def _sample_geometry(nt: NestTrace, ref_idx: int, samples):
-    """Sample tuples -> (tid, p0, line, m) in the thread-local trace."""
+def _sample_geometry(nt: NestTrace, ref_idx: int, samples, rx=None):
+    """Sample tuples -> (tid, p0, line, m) in the thread-local trace.
+
+    `rx` (default ref_idx) indexes the value overlay — a traced scalar
+    in the shared kernels, so refs that differ only in offsets/affine
+    constants (e.g. the read/write halves of `C[i][j] +=`) reuse one
+    compiled kernel; ref_idx supplies the static structure (level,
+    slot layout)."""
     t = nt.tables
     sched = nt.schedule
+    rx = ref_idx if rx is None else rx
     lv = int(t.ref_levels[ref_idx])
     n = [samples[:, l] for l in range(lv + 1)]
     tid = sched.owner_tid(n[0])
     m = sched.local_index(n[0])
     v0 = sched.value(n[0])
     vals = [v0] + [
-        nt.nest.loops[l].start_at(v0) + n[l] * nt.nest.loops[l].step
+        nt.start_at(l, v0) + n[l] * nt.nest.loops[l].step
         for l in range(1, lv + 1)
     ]
     if nt.tri:
-        base = jnp.asarray(nt.tri_base)[tid, m]
+        base = jnp.asarray(nt.vals["tri_base"])[tid, m]
         p0 = nt.tri_position(
             ref_idx, v0, base, n[1] if lv >= 1 else 0,
             n[2] if lv >= 2 else 0,
         )
     else:
         p0 = nt.access_position(
-            ref_idx, m, n[1] if lv >= 1 else 0, n[2] if lv >= 2 else 0
+            ref_idx, m, n[1] if lv >= 1 else 0, n[2] if lv >= 2 else 0,
+            rx=rx,
         )
-    flat = jnp.full_like(p0, int(t.ref_consts[ref_idx]))
+    flat = jnp.zeros_like(p0) + nt.vals["const"][rx]
     for l in range(lv + 1):
-        flat = flat + vals[l] * int(t.ref_coeffs[ref_idx][l])
+        flat = flat + vals[l] * nt.vals["coeff"][rx][l]
     line = flat * nt.machine.ds // nt.machine.cls
     return tid, p0, line, m
 
@@ -447,20 +581,9 @@ def _best_sink(nt: NestTrace, ref_idx: int, tid, p0, line, m0):
     """
     from .nextuse import next_use_candidates_group, next_use_candidates_tri_group
 
-    t = nt.tables
-    groups: dict[tuple, list[int]] = {}
-    for j in range(t.n_refs):
-        if t.ref_arrays[j] != t.ref_arrays[ref_idx]:
-            continue
-        key = (
-            int(t.ref_levels[j]),
-            tuple(int(c) for c in t.ref_coeffs[j]),
-            int(t.ref_consts[j]),
-        )
-        groups.setdefault(key, []).append(j)
     best = jnp.full_like(p0, INF)
     best_sink = jnp.zeros_like(p0, dtype=jnp.int32)
-    for sinks in groups.values():
+    for sinks in _sink_groups(nt, ref_idx):
         if nt.tri:
             bests = next_use_candidates_tri_group(
                 nt, tuple(sinks), tid, p0, line, m0
@@ -515,10 +638,8 @@ def _program_kernels(program: Program, machine: MachineConfig):
                 "or stream engine"
             )
         for ri in range(nt.tables.n_refs):
-            kernels.append(
-                (k, ri, _build_ref_kernel(nt, ri),
-                 _build_ref_kernel_scan(nt, ri))
-            )
+            ks = _kernels_for(nt, ri)
+            kernels.append((k, ri, ks["plain"], ks["scan"]))
     return trace, kernels
 
 
@@ -569,8 +690,8 @@ def warmup(
                     ))
                 dummy = jnp.zeros(B, dtype=jnp.int64)
                 jax.block_until_ready(kernel_s(
-                    dummy, dummy < 0, tuple(highs), capacity,
-                    B // batch,
+                    dummy, dummy < 0, _pad_highs(highs), nt.vals,
+                    np.int64(ri), capacity, B // batch,
                 ))
                 continue
             # over-budget refs take the host path below
@@ -579,7 +700,10 @@ def warmup(
             keys, 1, total=batch if s > batch else None
         )
         jax.block_until_ready(
-            kernel(jnp.asarray(chunk), n_valid, tuple(highs), capacity)
+            kernel(
+                jnp.asarray(chunk), n_valid, _pad_highs(highs), nt.vals,
+                np.int64(ri), capacity,
+            )
         )
 
 
@@ -592,7 +716,9 @@ def warmup(
 # (cfg.device_draw) changed them again. v5: the 2^46 device-draw bias
 # cap (draw.py::_DEVICE_DRAW_MAX_SPACE) reroutes huge-box refs to the
 # host stream, changing their per-seed sample sets under device_draw.
-_CHECKPOINT_SCHEMA = 5
+# v6: geometric draw-buffer bucketing (draw.py::bucket_size) changed
+# the device-drawn buffer sizes and with them the per-seed sample sets.
+_CHECKPOINT_SCHEMA = 6
 
 
 def _use_device_draw(cfg) -> bool:
@@ -748,11 +874,14 @@ def sampled_outputs(
             cold += float(c)
             decode_pairs(keys, counts, noshare, share)
 
+        ph = _pad_highs(highs)
+        rxv = np.int64(ri)
         if drawn is not None:
             n_chunks = dev_keys.shape[0] // batch
 
-            def redo(c2, dk=dev_keys, dm=dev_mask, nc=n_chunks):
-                return kernel_s(dk, dm, tuple(highs), c2, nc)
+            def redo(c2, dk=dev_keys, dm=dev_mask, nc=n_chunks, ph=ph,
+                     nv=nt.vals, rxv=rxv):
+                return kernel_s(dk, dm, ph, nv, rxv, c2, nc)
 
             pending.append((redo(cap), redo, cap))
         else:
@@ -763,8 +892,9 @@ def sampled_outputs(
                 )
                 chunk = jnp.asarray(chunk)
 
-                def redo(c2, chunk=chunk, n_valid=n_valid):
-                    return kernel(chunk, n_valid, tuple(highs), c2)
+                def redo(c2, chunk=chunk, n_valid=n_valid, ph=ph,
+                         nv=nt.vals, rxv=rxv):
+                    return kernel(chunk, n_valid, ph, nv, rxv, c2)
 
                 pending.append((redo(cap), redo, cap))
                 if len(pending) >= 4:

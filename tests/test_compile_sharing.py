@@ -1,0 +1,131 @@
+"""Compile-sharing contract of the sampled engine's kernels.
+
+Round-4 verdict item 2: kernels used to be compiled per (ref, N) —
+`highs` and every trace number were baked into the jaxpr, so each
+(ref, N) pair paid its own ~1-1.5 min compile through the tunneled AOT
+helper (BASELINE.md "Compile costs through the tunnel"). Now the
+structure lives in a signature-keyed kernel cache
+(sampler/sampled.py::_kernel_sig) and every N-dependent number rides in
+as a device operand (nt.vals, padded highs, the traced ref index rx).
+
+These tests pin the two halves of that contract:
+
+1. sharing: one compiled kernel serves every N and every structurally
+   identical ref — GEMM collapses to 4 kernels (C0/C1 pair, C2/C3
+   pair, A0, B0) and a second N adds ZERO jit cache entries;
+2. no leakage: a kernel built at one N produces bit-identical results
+   at another N to a kernel built fresh at that N (a concrete value
+   accidentally read from the builder trace instead of the operands
+   would break this).
+"""
+
+import numpy as np
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
+from pluss_sampler_optimization_tpu.models import REGISTRY
+from pluss_sampler_optimization_tpu.sampler import sampled as S
+
+MACHINE = MachineConfig()
+
+
+def _state_dump(state):
+    return (
+        [sorted(h.items()) for h in state.noshare],
+        [sorted((k, sorted(v.items())) for k, v in h.items())
+         for h in state.share],
+    )
+
+
+def test_kernel_signature_invariant_across_n():
+    """The structural signature — everything a compiled kernel bakes
+    in — must not depend on N once the band plans stabilize."""
+    for model in ("gemm", "2mm", "jacobi-2d"):
+        t1 = ProgramTrace(REGISTRY[model](128), MACHINE)
+        t2 = ProgramTrace(REGISTRY[model](512), MACHINE)
+        for nt1, nt2 in zip(t1.nests, t2.nests):
+            for ri in range(nt1.tables.n_refs):
+                assert S._kernel_sig(nt1, ri) == S._kernel_sig(nt2, ri), (
+                    f"{model} ref {ri}: signature differs across N"
+                )
+
+
+def test_gemm_cold_warmup_kernel_count():
+    """Cold GEMM = 4 distinct kernels at any N: the round-4 verdict's
+    'one compiled kernel per (depth, batch, capacity) serves every N
+    and ref'. C0/C1 (2-deep C pair) and C2/C3 (3-deep C pair) each
+    share one compile; A0 and B0 are structurally distinct."""
+    S._SIG_KERNELS.clear()
+    S._program_kernels.cache_clear()
+    S._program_kernels(REGISTRY["gemm"](256), MACHINE)
+    assert len(S._SIG_KERNELS) == 4
+    S._program_kernels(REGISTRY["gemm"](4096), MACHINE)
+    assert len(S._SIG_KERNELS) == 4  # another N adds nothing
+
+
+def test_no_recompile_and_no_leakage_across_n():
+    """Running a second N through kernels built at a first N must (a)
+    add zero jit cache entries — same shapes, same structure, values as
+    operands — and (b) produce results bit-identical to kernels built
+    fresh at that N."""
+    # ratio/batch chosen so every ref's sample count exceeds the batch:
+    # all chunks pad to exactly `batch` and shapes match across N
+    cfg = SamplerConfig(ratio=0.4, seed=3)
+    kw = dict(batch=1 << 10)
+
+    S._SIG_KERNELS.clear()
+    S._program_kernels.cache_clear()
+    st_a, _ = S.run_sampled(REGISTRY["gemm"](128), MACHINE, cfg, **kw)
+    compiles_after_first = sum(
+        e["plain"]._cache_size() for e in S._SIG_KERNELS.values()
+    )
+    st_b, _ = S.run_sampled(REGISTRY["gemm"](160), MACHINE, cfg, **kw)
+    compiles_after_second = sum(
+        e["plain"]._cache_size() for e in S._SIG_KERNELS.values()
+    )
+    assert compiles_after_second == compiles_after_first, (
+        "second N retraced shared kernels"
+    )
+
+    # leakage check: fresh kernels built AT N=160 must agree bit-exactly
+    S._SIG_KERNELS.clear()
+    S._program_kernels.cache_clear()
+    st_fresh, _ = S.run_sampled(REGISTRY["gemm"](160), MACHINE, cfg, **kw)
+    assert _state_dump(st_b) == _state_dump(st_fresh)
+
+
+def test_cross_model_sharing_is_structural_only():
+    """2mm's GEMM-shaped nests may share kernels with gemm ONLY when
+    the full signature matches; a signature mismatch must yield
+    distinct kernels rather than a wrong shared one. (The leakage test
+    above is the behavioral guarantee; this pins that the cache key is
+    the signature and nothing looser.)"""
+    S._SIG_KERNELS.clear()
+    S._program_kernels.cache_clear()
+    S._program_kernels(REGISTRY["gemm"](128), MACHINE)
+    n_gemm = len(S._SIG_KERNELS)
+    S._program_kernels(REGISTRY["2mm"](128), MACHINE)
+    trace = ProgramTrace(REGISTRY["2mm"](128), MACHINE)
+    sigs = {
+        S._kernel_sig(nt, ri)
+        for nt in trace.nests
+        for ri in range(nt.tables.n_refs)
+    }
+    assert len(S._SIG_KERNELS) == n_gemm + len(
+        sigs - {
+            S._kernel_sig(nt, ri)
+            for nt in ProgramTrace(REGISTRY["gemm"](128), MACHINE).nests
+            for ri in range(nt.tables.n_refs)
+        }
+    )
+
+
+def test_padded_highs_decode_roundtrip():
+    """Padded highs (1s beyond the ref depth) decode exactly like the
+    unpadded radix for keys in the ref's own space."""
+    highs = [7, 5]
+    keys = np.arange(35, dtype=np.int64)
+    a = np.asarray(S.decode_sample_keys(keys, tuple(highs)))
+    b = np.asarray(S.decode_sample_keys(keys, S._pad_highs(highs)))
+    assert (b[:, : len(highs)] == a).all()
+    assert (b[:, len(highs):] == 0).all()

@@ -134,6 +134,7 @@ def test_masked_kernel_matches_prefix_kernel():
     from pluss_sampler_optimization_tpu.sampler.sampled import (
         _build_ref_kernel,
         _build_ref_kernel_masked,
+        _pad_highs,
         pad_keys,
     )
 
@@ -146,12 +147,17 @@ def test_masked_kernel_matches_prefix_kernel():
         keys, chosen, _s, highs = out
         # masked form: the buffer exactly as the device path feeds it
         km = _build_ref_kernel_masked(nt, ri)
-        mk, mc, mu, mcold = km(keys, chosen, tuple(highs), 64)
+        mk, mc, mu, mcold = km(
+            keys, chosen, _pad_highs(highs), nt.vals, np.int64(ri), 64
+        )
         # prefix form: compact the chosen keys, pad like the host path
         compact = np.asarray(keys)[np.asarray(chosen)]
         chunk, n_valid = pad_keys(compact, 1)
         kp = _build_ref_kernel(nt, ri)
-        pk, pc, pu, pcold = kp(jnp.asarray(chunk), n_valid, tuple(highs), 64)
+        pk, pc, pu, pcold = kp(
+            jnp.asarray(chunk), n_valid, _pad_highs(highs), nt.vals,
+            np.int64(ri), 64
+        )
 
         def pairs(k, c):
             k, c = np.asarray(k), np.asarray(c)

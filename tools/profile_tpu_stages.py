@@ -125,10 +125,15 @@ def main() -> int:
     print(f"device draw:     {sorted(ts)[1] * 1e3:9.2f} ms  "
           f"(cold {t_cold:.1f} s; B={dk.shape[0]}, s={s})")
 
+    from pluss_sampler_optimization_tpu.sampler.sampled import _pad_highs
+
     kscan = _build_ref_kernel_scan(nt, args.ref)
     nc = dk.shape[0] // batch
     t = med_time(
-        lambda: kscan(dk, dm, tuple(dhighs), 64, nc), reps=3
+        lambda: kscan(
+            dk, dm, _pad_highs(dhighs), nt.vals, np.int64(args.ref), 64, nc
+        ),
+        reps=3,
     )
     print(f"scan kernel:     {t * 1e3:9.2f} ms  (n_chunks={nc})")
     return 0
