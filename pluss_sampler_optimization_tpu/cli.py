@@ -85,6 +85,10 @@ def _run_engine(engine: str, program, machine, args):
         from .sampler.periodic import run_exact
 
         return run_exact(program, machine), None
+    if engine == "analytic":
+        from .sampler.analytic import run_analytic
+
+        return run_analytic(program, machine), None
     if engine in ("sampled", "sharded"):
         from .config import SamplerConfig
 
@@ -134,10 +138,12 @@ def main(argv=None) -> int:
         "--engine",
         default=None,
         help="oracle | numpy | native | native-par | dense | stream | "
-        "periodic | exact | sampled | sharded (default: dense; sample "
-        "mode forces sampled; 'exact' picks the fastest applicable "
-        "exact engine: periodic when its preconditions hold, else "
-        "dense with its memory auto-route)",
+        "periodic | analytic | exact | sampled | sharded (default: "
+        "dense; sample mode forces sampled; 'exact' picks the fastest "
+        "applicable exact engine: periodic when its preconditions "
+        "hold, then analytic (closed-form next-use per period — covers "
+        "triangular nests and mixed parallel coefficients), else dense "
+        "with its memory auto-route)",
     )
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--chunk", type=int, default=4)

@@ -463,17 +463,25 @@ def validate_periodic(program: Program, machine: MachineConfig) -> None:
 def run_exact(program: Program, machine: MachineConfig,
               max_share: int = 64) -> OracleResult:
     """Fastest applicable exact engine: periodic when its
-    preconditions hold, else dense — whose own auto-route covers the
-    memory ceiling by falling to stream. All three produce
-    bit-identical PRIStates (tests), so callers wanting "the exact
-    histogram, fast" need no engine knowledge. The CLI's
+    preconditions hold, then the analytic closed-form engine
+    (sampler/analytic.py — covers the periodic rejections: triangular
+    nests and mixed parallel coefficients), then dense — whose own
+    auto-route covers the memory ceiling by falling to stream. All of
+    them produce bit-identical PRIStates (tests), so callers wanting
+    "the exact histogram, fast" need no engine knowledge. The CLI's
     `--engine exact` is this function."""
     try:
         validate_periodic(program, machine)
     except NotImplementedError:
-        from .dense import run_dense
+        from .analytic import run_analytic, validate_analytic
 
-        return run_dense(program, machine, max_share)
+        try:
+            validate_analytic(program, machine)
+        except NotImplementedError:
+            from .dense import run_dense
+
+            return run_dense(program, machine, max_share)
+        return run_analytic(program, machine)
     return run_periodic(program, machine, max_share)
 
 

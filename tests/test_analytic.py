@@ -1,0 +1,92 @@
+"""Analytic exact engine: bit-equality vs the oracle on exactly the
+classes the periodic engine rejects (round-4 verdict item 4).
+
+The engine's soundness story (sampler/analytic.py docstring) rests on
+exact probe evaluations + exact affine fits + the per-period count
+identity; these tests pin the end result — bit-identical PRIStates —
+for every rejected family at several N, including non-power-of-two N
+(multi-phase classes) and machine-geometry variations.
+"""
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig
+from pluss_sampler_optimization_tpu.models import REGISTRY
+from pluss_sampler_optimization_tpu.oracle import run_numpy
+from pluss_sampler_optimization_tpu.sampler.analytic import run_analytic
+from pluss_sampler_optimization_tpu.sampler.periodic import (
+    run_exact,
+    validate_periodic,
+)
+
+MACHINE = MachineConfig()
+
+
+def _dump(state):
+    return (
+        [sorted(h.items()) for h in state.noshare],
+        [sorted((k, sorted(v.items())) for k, v in h.items())
+         for h in state.share],
+    )
+
+
+# the periodic engine's rejected classes, plus gemm as the rectangular
+# control (also covered by periodic, so all three exact engines must
+# agree there)
+@pytest.mark.parametrize("model,n", [
+    ("syrk", 24),        # mixed parallel coefficients on array A
+    ("syrk", 40),        # and a second size
+    ("syrk-tri", 24),    # triangular family
+    ("syrk-tri", 33),    # non-pow2: multi-phase v0 classes
+    ("trmm", 24),
+    ("trisolv", 32),
+    ("covariance", 24),
+    ("gemm", 24),        # rectangular control
+])
+def test_analytic_bit_exact_vs_oracle(model, n):
+    prog = REGISTRY[model](n)
+    a = run_analytic(prog, MACHINE, batch=1 << 12)
+    o = run_numpy(prog, MACHINE)
+    assert a.total_accesses == o.total_accesses
+    assert _dump(a.state) == _dump(o.state)
+
+
+def test_analytic_odd_geometry():
+    """Non-default simulated machine: different thread/chunk counts
+    change the class structure (chunk positions, tails)."""
+    m = MachineConfig(thread_num=3, chunk_size=5)
+    prog = REGISTRY["syrk-tri"](26)
+    a = run_analytic(prog, m, batch=1 << 12)
+    o = run_numpy(prog, m)
+    assert _dump(a.state) == _dump(o.state)
+
+
+def test_exact_router_covers_rejected_classes():
+    """--engine exact must route periodic-rejected programs to the
+    analytic engine (not the 0.05x dense path) and stay bit-exact."""
+    for model, n in (("syrk", 24), ("syrk-tri", 24)):
+        prog = REGISTRY[model](n)
+        with pytest.raises(NotImplementedError):
+            validate_periodic(prog, MACHINE)
+        r = run_exact(prog, MACHINE)
+        o = run_numpy(prog, MACHINE)
+        assert _dump(r.state) == _dump(o.state)
+
+
+def test_analytic_count_identity_guard():
+    """The engine self-checks sum(slot counts)+cold == box size for
+    every fitted class; a healthy run raises nothing and matches the
+    oracle total exactly (this is the cheap always-on invariant that
+    keeps a wrong count formula from passing silently)."""
+    prog = REGISTRY["syrk"](32)
+    a = run_analytic(prog, MACHINE, batch=1 << 12)
+    # total accesses == sum over state of... the state holds weighted
+    # bins; the invariant surfaced here is the total access count
+    assert a.total_accesses == run_numpy(prog, MACHINE).total_accesses
+    total_folded = sum(
+        sum(h.values()) for h in a.state.noshare
+    ) + sum(
+        sum(sum(hh.values()) for hh in h.values()) for h in a.state.share
+    )
+    assert total_folded == a.total_accesses
