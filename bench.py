@@ -507,15 +507,17 @@ def main() -> int:
     ap.add_argument("--model", default="gemm")
     ap.add_argument("--engine", default="sampled",
                     choices=["sampled", "dense", "stream", "periodic",
-                             "exact"],
+                             "analytic", "exact"],
                     help="sampled = random-start closed-form engine "
                     "(the r10 equivalent); dense/stream = exact "
                     "full-traversal engines (the ri/ri-opt speed "
                     "rows); periodic = exact engine from O(1) "
                     "two-period windows (sampler/periodic.py); "
-                    "exact = fastest applicable exact path "
-                    "(periodic -> dense -> stream auto-route, same "
-                    "as the package CLI's --engine exact)")
+                    "analytic = exact closed-form next-use per period "
+                    "(sampler/analytic.py — covers the classes "
+                    "periodic rejects); exact = fastest applicable "
+                    "exact path (periodic -> analytic -> dense "
+                    "auto-route, same as the package CLI)")
     ap.add_argument("--ratio", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--reps", type=int, default=3,
@@ -696,6 +698,13 @@ def main() -> int:
             )
 
             res = run_periodic(prog, machine)
+            return res.state, res.total_accesses
+        if args.engine == "analytic":
+            from pluss_sampler_optimization_tpu.sampler.analytic import (
+                run_analytic,
+            )
+
+            res = run_analytic(prog, machine)
             return res.state, res.total_accesses
         if args.engine == "exact":
             from pluss_sampler_optimization_tpu.sampler.periodic import (

@@ -184,8 +184,7 @@ def _build_sharded_ref_kernel(
 # (sig, mesh, capacity, pallas, scan) -> shared jitted kernel; same
 # sharing rule as sampler/sampled.py::_SIG_KERNELS — structure in the
 # closure, every N-dependent number in the highs/vals operands.
-# Bounded LRU: closures pin a NestTrace + executables, and capacity
-# regrows mint additional entries.
+# Bounded (capacity regrows mint additional entries).
 import collections as _collections
 
 _SHARDED_SIG_KERNELS: "_collections.OrderedDict" = _collections.OrderedDict()
@@ -196,20 +195,16 @@ def _sharded_kernels_for(
     nt: NestTrace, ref_idx: int, mesh, capacity: int,
     use_pallas_hist: bool, scan: bool,
 ):
-    key = (
-        _kernel_sig(nt, ref_idx), mesh, capacity, use_pallas_hist, scan,
-    )
-    kern = _SHARDED_SIG_KERNELS.get(key)
-    if kern is None:
-        kern = _build_sharded_ref_kernel(
+    from ..sampler.sampled import lru_cached
+
+    return lru_cached(
+        _SHARDED_SIG_KERNELS,
+        (_kernel_sig(nt, ref_idx), mesh, capacity, use_pallas_hist, scan),
+        lambda: _build_sharded_ref_kernel(
             nt, ref_idx, mesh, capacity, use_pallas_hist, scan
-        )
-        _SHARDED_SIG_KERNELS[key] = kern
-        while len(_SHARDED_SIG_KERNELS) > _SHARDED_SIG_KERNELS_MAX:
-            _SHARDED_SIG_KERNELS.popitem(last=False)
-    else:
-        _SHARDED_SIG_KERNELS.move_to_end(key)
-    return kern
+        ),
+        _SHARDED_SIG_KERNELS_MAX,
+    )
 
 
 @functools.lru_cache(maxsize=16)

@@ -396,32 +396,65 @@ def _sink_groups(nt: NestTrace, ref_idx: int) -> list:
     return list(groups.values())
 
 
-# signature -> {"plain": ..., "scan": ..., "masked": ...} jitted kernels.
-# The closures hold the FIRST trace that produced the signature, for
-# structure only; values always arrive through the vals operand.
-# Bounded LRU: each closure pins a whole NestTrace (incl. tri_base at
-# triangular N) plus compiled executables for process lifetime.
 import collections as _collections
 
+
+def lru_cached(cache: "_collections.OrderedDict", key, build, maxsize: int):
+    """Bounded LRU lookup shared by the kernel signature caches here
+    and in parallel/sharded.py: each cached closure pins a whole
+    NestTrace (incl. tri_base at triangular N) plus compiled
+    executables, so the caches must evict."""
+    entry = cache.get(key)
+    if entry is None:
+        entry = build()
+        cache[key] = entry
+        while len(cache) > maxsize:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(key)
+    return entry
+
+
+# signature -> {"plain": ..., "scan": ..., "masked": ..., "raw": ...}
+# jitted kernels. The closures hold the FIRST trace that produced the
+# signature, for structure only; values always arrive through the vals
+# operand.
 _SIG_KERNELS: "_collections.OrderedDict" = _collections.OrderedDict()
 _SIG_KERNELS_MAX = 64
 
 
 def _kernels_for(nt: NestTrace, ref_idx: int) -> dict:
-    sig = _kernel_sig(nt, ref_idx)
-    entry = _SIG_KERNELS.get(sig)
-    if entry is None:
-        entry = {
+    return lru_cached(
+        _SIG_KERNELS,
+        _kernel_sig(nt, ref_idx),
+        lambda: {
             "plain": _build_ref_kernel(nt, ref_idx),
             "scan": _build_ref_kernel_scan(nt, ref_idx),
             "masked": _build_ref_kernel_masked(nt, ref_idx),
-        }
-        _SIG_KERNELS[sig] = entry
-        while len(_SIG_KERNELS) > _SIG_KERNELS_MAX:
-            _SIG_KERNELS.popitem(last=False)
-    else:
-        _SIG_KERNELS.move_to_end(sig)
-    return entry
+            "raw": _build_ref_kernel_raw(nt, ref_idx),
+        },
+        _SIG_KERNELS_MAX,
+    )
+
+
+def _build_ref_kernel_raw(nt: NestTrace, ref_idx: int):
+    """Classify only — (packed, found) per sample, no on-device unique
+    reduction. The analytic exact engine (sampler/analytic.py) consumes
+    whole period boxes whose handful of distinct values it extracts
+    host-side with np.unique: on the CPU backend numpy's sort is ~5x
+    XLA's, and on accelerators the per-chunk fetch is batch-sized and
+    sequential-friendly. The sampled engine keeps the on-device
+    reductions (its chunks stream over a possibly tunneled link)."""
+    check_packed_ratios(nt)
+
+    @jax.jit
+    def kernel(sample_keys, highs, vals, rx):
+        snt = nt.with_vals(vals)
+        samples = decode_sample_keys(jnp.asarray(sample_keys), highs)
+        packed, _, _, found = classify_samples(snt, ref_idx, samples, rx)
+        return packed, found
+
+    return kernel
 
 
 def _build_ref_kernel(nt: NestTrace, ref_idx: int):
