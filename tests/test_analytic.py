@@ -74,6 +74,32 @@ def test_exact_router_covers_rejected_classes():
         assert _dump(r.state) == _dump(o.state)
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_analytic_fuzz_models_geometries(seed):
+    """Random (model, N, machine geometry) at sizes where the affine
+    FIT machinery actually engages (N >= _ROW_FIT_MIN rows, enough
+    periods for v0 classes): odd thread/chunk counts change the class
+    structure, tails, and coincidence sets. Bit-equality vs the numpy
+    oracle is the whole assertion — any fit accepting a wrong model
+    fails here."""
+    rng = np.random.default_rng(1000 + seed)
+    # round-robin, not rng.choice: every model family — syrk's mixed
+    # coefficients included — must be exercised at fit-engaging sizes
+    models = ["syrk", "syrk-tri", "trmm", "trisolv", "covariance",
+              "gemm"]
+    model = models[seed % len(models)]
+    n = int(rng.integers(100, 170))
+    m = MachineConfig(
+        thread_num=int(rng.integers(2, 6)),
+        chunk_size=int(rng.integers(2, 7)),
+    )
+    prog = REGISTRY[model](n)
+    a = run_analytic(prog, m, batch=1 << 14)
+    o = run_numpy(prog, m)
+    assert a.total_accesses == o.total_accesses, (model, n)
+    assert _dump(a.state) == _dump(o.state), (model, n)
+
+
 def test_analytic_count_identity_guard():
     """The engine self-checks sum(slot counts)+cold == box size for
     every fitted class; a healthy run raises nothing and matches the
