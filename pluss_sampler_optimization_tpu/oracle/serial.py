@@ -41,6 +41,10 @@ class OracleResult:
     state: PRIState
     total_accesses: int
     per_tid_accesses: list
+    # which engine produced the result, when a router (e.g.
+    # periodic.run_exact) chose one; None when the caller invoked an
+    # engine directly
+    engine: str | None = None
 
 
 def run_serial(

@@ -480,9 +480,17 @@ def run_exact(program: Program, machine: MachineConfig,
         except NotImplementedError:
             from .dense import run_dense
 
-            return run_dense(program, machine, max_share)
-        return run_analytic(program, machine)
-    return run_periodic(program, machine, max_share)
+            res = run_dense(program, machine, max_share)
+            # run_dense itself may have auto-routed past its memory
+            # ceiling; it reports nothing, so the label stays coarse
+            res.engine = "dense"
+            return res
+        res = run_analytic(program, machine)
+        res.engine = "analytic"
+        return res
+    res = run_periodic(program, machine, max_share)
+    res.engine = "periodic"
+    return res
 
 
 def run_periodic(program: Program, machine: MachineConfig,
