@@ -64,14 +64,17 @@ def test_analytic_odd_geometry():
 
 def test_exact_router_covers_rejected_classes():
     """--engine exact must route periodic-rejected programs to the
-    analytic engine (not the 0.05x dense path) and stay bit-exact."""
+    analytic engine (not the 0.05x dense path), stay bit-exact, and
+    report the engine it chose (bench's secondary row records it)."""
     for model, n in (("syrk", 24), ("syrk-tri", 24)):
         prog = REGISTRY[model](n)
         with pytest.raises(NotImplementedError):
             validate_periodic(prog, MACHINE)
         r = run_exact(prog, MACHINE)
+        assert r.engine == "analytic"
         o = run_numpy(prog, MACHINE)
         assert _dump(r.state) == _dump(o.state)
+    assert run_exact(REGISTRY["gemm"](24), MACHINE).engine == "periodic"
 
 
 @pytest.mark.parametrize("seed", range(6))
