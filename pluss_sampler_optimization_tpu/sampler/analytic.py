@@ -53,7 +53,11 @@ chunk), and exhaustive per-period sweeps against brute-force
 evaluation in the tests for every rejected model family at multiple N
 (tests/test_analytic.py). Programs outside the tested families get the
 same defenses but inherit the assumption; bit-exactness there is
-backed by the probes, not proven.
+backed by the probes, not proven — `tools/verify_analytic.py` removes
+it for a concrete (program, machine) by brute-force classifying every
+period (auditing the row-level fits) AND comparing run_analytic's
+final state against the all-periods-direct fold (auditing the
+v0-level class fits).
 
 The reference has no analog of this decomposition: its exact samplers
 walk the full trace access-by-access with hash-map LATs
