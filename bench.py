@@ -379,8 +379,27 @@ def _snap_counters(counters: dict) -> dict:
     return snap
 
 
-EVIDENCE_SIDECAR = "BENCH_EVIDENCE.json"
+EVIDENCE_SIDECAR = "BENCH_EVIDENCE.json"  # `latest` pointer, kept stable
 HEADLINE_MAX_BYTES = 500
+
+_RUN_SEQ = [0]  # process-local tiebreak: same-second same-pid calls
+
+
+def _stamped_sidecar_name(metric: str) -> str:
+    """Per-run evidence filename: metric + run id (UTC timestamp, pid,
+    in-process sequence). Back-to-back or concurrent bench invocations
+    each keep their own evidence instead of clobbering one shared file
+    — round 5's on-disk BENCH_EVIDENCE.json held a different run than
+    the headline pointing at it (VERDICT weak #4)."""
+    import re
+
+    safe = re.sub(r"[^A-Za-z0-9._-]+", "-", metric)[:60]
+    _RUN_SEQ[0] += 1
+    rid = "%s-%d-%d" % (
+        time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+        os.getpid(), _RUN_SEQ[0],
+    )
+    return f"BENCH_EVIDENCE_{safe}_{rid}.json"
 
 
 def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
@@ -391,11 +410,15 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
     one giant JSON line (headline + all evidence inlined) outgrew the
     tail capture and `BENCH_r04.json` recorded `parsed: null` — the
     round's number was simply lost. So the full record goes on an
-    EARLIER stdout line and into a sidecar file (`BENCH_EVIDENCE.json`
-    next to this script), and the final line is a small headline —
+    EARLIER stdout line and into a STAMPED sidecar file (metric +
+    run id in the name, next to this script) that the headline's
+    `evidence` field names; `BENCH_EVIDENCE.json` is maintained as a
+    `latest` pointer to the stamped file for tooling that greps the
+    fixed name. The final line is a small headline —
     metric/value/unit/vs_baseline plus the few numbers a reader needs
-    at a glance and a pointer to the evidence — guaranteed under
-    HEADLINE_MAX_BYTES so it survives any reasonable tail.
+    at a glance and the evidence pointer — ENFORCED under
+    HEADLINE_MAX_BYTES (optional keys drop first, then the metric
+    string itself truncates) so it survives any reasonable tail.
 
     Returns the final line (for tests).
     """
@@ -405,14 +428,31 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
     print(json.dumps(full), file=out)
 
     sidecar_dir = sidecar_dir or os.path.dirname(os.path.abspath(__file__))
-    sidecar = os.path.join(sidecar_dir, EVIDENCE_SIDECAR)
-    evidence_ref = EVIDENCE_SIDECAR
+    stamped = _stamped_sidecar_name(str(headline.get("metric", "run")))
+    evidence_ref = stamped
     try:
-        with open(sidecar, "w") as f:
+        with open(os.path.join(sidecar_dir, stamped), "w") as f:
             json.dump(full, f, indent=1)
             f.write("\n")
     except OSError:
         evidence_ref = "stdout line above (sidecar write failed)"
+    else:
+        # `latest` pointer at the old fixed name: a symlink where the
+        # filesystem allows it, else a tiny JSON pointer file — never
+        # a second copy of the evidence (the copy WAS the staleness
+        # hazard: it described whichever run wrote it last)
+        latest = os.path.join(sidecar_dir, EVIDENCE_SIDECAR)
+        try:
+            if os.path.islink(latest) or os.path.exists(latest):
+                os.remove(latest)
+            os.symlink(stamped, latest)
+        except OSError:
+            try:
+                with open(latest, "w") as f:
+                    json.dump({"latest": stamped}, f)
+                    f.write("\n")
+            except OSError:
+                pass
 
     compact = dict(headline)
     compact["device"] = extra.get("device")
@@ -423,6 +463,16 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
     pex = extra.get("periodic_exact") or {}
     if isinstance(pex, dict) and "vs_baseline" in pex:
         optional["periodic_exact_vs"] = pex["vs_baseline"]
+    aex = extra.get("analytic_exact") or {}
+    if isinstance(aex, dict) and "engine" in aex:
+        # the exact router's secondary row, engine label included —
+        # the driver's tail is where an `"engine": "analytic"` row
+        # must be visible (VERDICT round 5, next-round #5)
+        optional["exact_secondary"] = {
+            k: aex[k]
+            for k in ("engine", "vs_baseline", "model")
+            if k in aex
+        }
     compact.update(optional)
     compact["evidence"] = evidence_ref
     line = json.dumps(compact)
@@ -431,6 +481,21 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
             break
         compact.pop(key)
         line = json.dumps(compact)
+    if len(line.encode()) > HEADLINE_MAX_BYTES:
+        # required fields alone overflow (unbounded metric name or the
+        # sidecar-failure fallback text): truncate the longest string
+        # fields until the contract holds instead of assuming it
+        for key in ("metric", "evidence"):
+            over = len(line.encode()) - HEADLINE_MAX_BYTES
+            if over <= 0:
+                break
+            s = str(compact.get(key, ""))
+            compact[key] = s.encode()[: max(8, len(s.encode()) - over)
+                                      ].decode("utf-8", "ignore")
+            line = json.dumps(compact)
+    assert len(line.encode()) <= HEADLINE_MAX_BYTES, (
+        f"headline still {len(line.encode())} bytes after truncation"
+    )
     print(line, file=out)
     return line
 
@@ -532,6 +597,16 @@ def main() -> int:
                     help="default matches the recorded 2mm baseline in "
                     "baselines/ (large enough that the sampled run is "
                     "not dispatch-bound)")
+    ap.add_argument("--exact-model", default="syrk",
+                    help="extra EXACT-router metric on a periodic-"
+                    "rejected model so the driver artifact carries an "
+                    "analytic-engine row ('' disables; default syrk — "
+                    "mixed parallel coefficients route it to the "
+                    "analytic engine, and a recorded serial baseline "
+                    "exists at --exact-n 1024)")
+    ap.add_argument("--exact-n", type=int, default=1024,
+                    help="size for --exact-model (default matches the "
+                    "recorded syrk baseline in baselines/)")
     ap.add_argument("--skip-baseline", action="store_true",
                     help="report throughput only, without measuring or "
                     "loading the serial baseline (for configs whose "
@@ -673,7 +748,9 @@ def main() -> int:
     machine = MachineConfig()
     # validate every model name BEFORE the (possibly hour-long) runs —
     # a typo in --second-model must not discard the headline metric
-    for name in filter(None, (args.model, args.second_model)):
+    for name in filter(
+        None, (args.model, args.second_model, args.exact_model)
+    ):
         if name not in REGISTRY:
             raise SystemExit(
                 f"unknown model {name!r} "
@@ -987,6 +1064,50 @@ def main() -> int:
             px["inapplicable"] = str(e)[:160]
         except Exception as e:  # never sink the headline metric
             px["error"] = repr(e)
+
+    # Analytic-router secondary row: one periodic-REJECTED model
+    # through the exact router, so the driver artifact itself carries
+    # an `"engine": "analytic"` row with a vs-serial score (round 5
+    # shipped the engine but its evidence lived only in BASELINE.md —
+    # VERDICT weak #3 / next-round #5). Separate from the
+    # periodic_exact row above, which runs the router on the HEADLINE
+    # model (periodic for gemm).
+    if (
+        args.engine == "sampled"
+        and not args.skip_baseline
+        and args.exact_model
+        and extras_budget_left("analytic_exact", extra)
+    ):
+        ax: dict = {"model": args.exact_model, "n": args.exact_n}
+        extra["analytic_exact"] = ax  # filled in place: a later
+        # scoring error must not discard the measured run
+        try:
+            from pluss_sampler_optimization_tpu.sampler.periodic import (
+                run_exact,
+            )
+
+            aprog = REGISTRY[args.exact_model](args.exact_n)
+            t0 = time.perf_counter()
+            c0 = time.process_time()
+            ares = run_exact(aprog, machine)
+            aw = time.perf_counter() - t0
+            ac = time.process_time() - c0
+            ax["engine"] = ares.engine
+            ax["engine_s_incl_compile"] = round(aw, 4)
+            ax["cpu_wall"] = round(ac / aw, 2) if aw > 0 else None
+            ax["accesses"] = ares.total_accesses
+            # mrc_l1_err lands from score_vs_serial; exact engines are
+            # bit-exact so it must come back 0.0
+            ax["vs_baseline"] = round(
+                score_vs_serial(
+                    args.exact_model, args.exact_n, aprog, ares.state,
+                    aw, ax,
+                ), 2,
+            )
+        except NotImplementedError as e:
+            ax["inapplicable"] = str(e)[:160]
+        except Exception as e:  # never sink the headline metric
+            ax["error"] = repr(e)
 
     # Second model, sampled engine vs the serial oracle: evidence that
     # the IR-generic engine's throughput story is not GEMM-specific.

@@ -31,6 +31,76 @@ def _pow2_floor_arr(x: np.ndarray) -> np.ndarray:
     return (np.int64(1) << (e.astype(np.int64) - 1)).astype(np.int64)
 
 
+def fold_nest_numpy(nt, tid: int, state: PRIState) -> int:
+    """Exact fold of one (nest, thread) into `state` via the host
+    lexsort; returns the thread's access count in this nest.
+
+    The body of run_numpy, exposed standalone because it is also the
+    fastest exact evaluator for SMALL nests: below a few million
+    accesses the whole per-thread sort costs milliseconds, where any
+    device-kernel route pays per-ref-structure dispatch/compile costs
+    first (sampler/analytic.py routes its small-nest case here)."""
+    t = nt.tables
+    parts = [nt.enumerate_ref(tid, ri) for ri in range(t.n_refs)]
+    pos = np.concatenate([p for p, _ in parts])
+    if len(pos) == 0:
+        return 0
+    addr = np.concatenate([a for _, a in parts])
+    arr = np.concatenate(
+        [
+            np.full(len(parts[ri][0]), t.ref_arrays[ri], dtype=np.int64)
+            for ri in range(t.n_refs)
+        ]
+    )
+    ref = np.concatenate(
+        [
+            np.full(len(parts[ri][0]), ri, dtype=np.int64)
+            for ri in range(t.n_refs)
+        ]
+    )
+    order = np.lexsort((pos, addr, arr))
+    pos_s, addr_s, arr_s, ref_s = (
+        pos[order], addr[order], arr[order], ref[order],
+    )
+    same = np.zeros(len(pos), dtype=bool)
+    same[1:] = (arr_s[1:] == arr_s[:-1]) & (addr_s[1:] == addr_s[:-1])
+    reuse = np.where(same, pos_s - np.concatenate(([0], pos_s[:-1])), 0)
+
+    r = reuse[same]
+    snk = ref_s[same]
+    s_thr = t.ref_share_thresholds[snk]
+    s_ratio = t.ref_share_ratios[snk]
+    is_share = (s_thr > 0) & (np.abs(r) > np.abs(r - s_thr))
+
+    # noshare: pow2-binned accumulate (pluss_utils.h:924-927)
+    ns = r[~is_share]
+    if len(ns):
+        binned = _pow2_floor_arr(ns)
+        keys, cnts = np.unique(binned, return_counts=True)
+        h = state.noshare[tid]
+        for key, c in zip(keys.tolist(), cnts.tolist()):
+            h[key] = h.get(key, 0.0) + float(c)
+
+    # share: raw keys per ratio (pluss_utils.h:928-937)
+    sh = r[is_share]
+    sh_ratio = s_ratio[is_share]
+    if len(sh):
+        for rat in np.unique(sh_ratio).tolist():
+            vals = sh[sh_ratio == rat]
+            keys, cnts = np.unique(vals, return_counts=True)
+            h = state.share[tid].setdefault(int(rat), {})
+            for key, c in zip(keys.tolist(), cnts.tolist()):
+                h[int(key)] = h.get(int(key), 0.0) + float(c)
+
+    # per-nest -1 flush: one per distinct (array, line)
+    # (...ri-omp-seq.cpp:303-319)
+    n_cold = int((~same).sum())
+    if n_cold:
+        h = state.noshare[tid]
+        h[-1] = h.get(-1, 0.0) + float(n_cold)
+    return len(pos)
+
+
 def run_numpy(program: Program, machine: MachineConfig) -> OracleResult:
     trace = ProgramTrace(program, machine)
     P = machine.thread_num
@@ -38,66 +108,8 @@ def run_numpy(program: Program, machine: MachineConfig) -> OracleResult:
     per_tid = [0] * P
 
     for k, nt in enumerate(trace.nests):
-        t = nt.tables
         for tid in range(P):
-            parts = [nt.enumerate_ref(tid, ri) for ri in range(t.n_refs)]
-            pos = np.concatenate([p for p, _ in parts])
-            if len(pos) == 0:
-                continue
-            per_tid[tid] += len(pos)
-            addr = np.concatenate([a for _, a in parts])
-            arr = np.concatenate(
-                [
-                    np.full(len(parts[ri][0]), t.ref_arrays[ri], dtype=np.int64)
-                    for ri in range(t.n_refs)
-                ]
-            )
-            ref = np.concatenate(
-                [
-                    np.full(len(parts[ri][0]), ri, dtype=np.int64)
-                    for ri in range(t.n_refs)
-                ]
-            )
-            order = np.lexsort((pos, addr, arr))
-            pos_s, addr_s, arr_s, ref_s = (
-                pos[order], addr[order], arr[order], ref[order],
-            )
-            same = np.zeros(len(pos), dtype=bool)
-            same[1:] = (arr_s[1:] == arr_s[:-1]) & (addr_s[1:] == addr_s[:-1])
-            reuse = np.where(same, pos_s - np.concatenate(([0], pos_s[:-1])), 0)
-
-            r = reuse[same]
-            snk = ref_s[same]
-            s_thr = t.ref_share_thresholds[snk]
-            s_ratio = t.ref_share_ratios[snk]
-            is_share = (s_thr > 0) & (np.abs(r) > np.abs(r - s_thr))
-
-            # noshare: pow2-binned accumulate (pluss_utils.h:924-927)
-            ns = r[~is_share]
-            if len(ns):
-                binned = _pow2_floor_arr(ns)
-                keys, cnts = np.unique(binned, return_counts=True)
-                h = state.noshare[tid]
-                for key, c in zip(keys.tolist(), cnts.tolist()):
-                    h[key] = h.get(key, 0.0) + float(c)
-
-            # share: raw keys per ratio (pluss_utils.h:928-937)
-            sh = r[is_share]
-            sh_ratio = s_ratio[is_share]
-            if len(sh):
-                for rat in np.unique(sh_ratio).tolist():
-                    vals = sh[sh_ratio == rat]
-                    keys, cnts = np.unique(vals, return_counts=True)
-                    h = state.share[tid].setdefault(int(rat), {})
-                    for key, c in zip(keys.tolist(), cnts.tolist()):
-                        h[int(key)] = h.get(int(key), 0.0) + float(c)
-
-            # per-nest -1 flush: one per distinct (array, line)
-            # (...ri-omp-seq.cpp:303-319)
-            n_cold = int((~same).sum())
-            if n_cold:
-                h = state.noshare[tid]
-                h[-1] = h.get(-1, 0.0) + float(n_cold)
+            per_tid[tid] += fold_nest_numpy(nt, tid, state)
 
     return OracleResult(
         state=state, total_accesses=sum(per_tid), per_tid_accesses=per_tid

@@ -19,6 +19,12 @@ a `jax.sharding.Mesh`:
 - the dense engine shards its vmapped simulated-thread axis with
   `NamedSharding` (the `ri` variant's `#pragma omp parallel for` over
   tids, ...ri.cpp:67-68, as SPMD);
+- the EXACT engines shard too (round 6): the periodic engine's merged
+  windows stack on one vmapped axis laid over the mesh
+  (`run_periodic_sharded`), and the analytic engine's period/row-block
+  classify mega-dispatches shard their key axis via GSPMD
+  (`run_analytic_sharded`); `run_exact_sharded` is the auto-router.
+  All are bit-identical to single-device (tests/test_parallel.py);
 - multi-host scaling needs no new code: the same mesh spans hosts and
   XLA routes the psum over ICI within a slice and DCN across slices.
 """
@@ -26,7 +32,10 @@ a `jax.sharding.Mesh`:
 from .distributed import build_global_mesh, initialize_distributed
 from .mesh import build_mesh, local_device_count
 from .sharded import (
+    run_analytic_sharded,
     run_dense_sharded,
+    run_exact_sharded,
+    run_periodic_sharded,
     run_sampled_sharded,
     sampled_outputs_sharded,
 )
@@ -39,4 +48,7 @@ __all__ = [
     "run_sampled_sharded",
     "sampled_outputs_sharded",
     "run_dense_sharded",
+    "run_periodic_sharded",
+    "run_analytic_sharded",
+    "run_exact_sharded",
 ]

@@ -69,6 +69,26 @@ from ..sampler.sampled import (
 from .mesh import build_mesh
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across the API move: top-level `jax.shard_map`
+    (with its `check_vma` varying-axes check) on current jax, the
+    `jax.experimental.shard_map` form (whose equivalent knob is
+    `check_rep`) on older installs. The check is disabled either way —
+    the all_gather outputs ARE replicated, but the static analysis
+    cannot infer that."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def _build_sharded_ref_kernel(
     nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int,
     use_pallas_hist: bool, scan: bool = False,
@@ -149,14 +169,11 @@ def _build_sharded_ref_kernel(
             return _mesh_reduce(nh, cold, mk, mc, max_nu)
 
         def entry(sample_keys, mask, highs, vals, rx, n_chunks: int):
-            return jax.shard_map(
+            return _shard_map(
                 functools.partial(local_fn, n_chunks=n_chunks),
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P()),
-                # all_gather outputs ARE replicated, but the static
-                # varying-axes check cannot infer that
-                check_vma=False,
             )(sample_keys, mask, highs, vals, rx)
 
         return jax.jit(entry, static_argnames=("n_chunks",))
@@ -170,12 +187,11 @@ def _build_sharded_ref_kernel(
         return _mesh_reduce(*_classify(sample_keys, w, highs, vals, rx))
 
     def entry(sample_keys, n_valid, highs, vals, rx):
-        return jax.shard_map(
+        return _shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(P(axis), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P(), P()),
-            check_vma=False,
         )(sample_keys, n_valid, highs, vals, rx)
 
     return jax.jit(entry)
@@ -438,6 +454,104 @@ def run_sampled_sharded(
     cfg = cfg or SamplerConfig()
     results, _ = sampled_outputs_sharded(program, machine, cfg, mesh, **kw)
     return fold_results(results, machine.thread_num, v2), results
+
+
+def run_periodic_sharded(
+    program: Program,
+    machine: MachineConfig,
+    mesh: jax.sharding.Mesh | None = None,
+    max_share: int = 64,
+):
+    """Periodic exact engine with the merged-window axis on the mesh.
+
+    Each nest's merged (delta, phase) windows stack on one axis,
+    evaluated by jit(vmap(window body)) with the axis laid over the
+    devices via NamedSharding — the same idiom as run_dense_sharded's
+    tid axis. Outputs come back per window (the per-tid multiplicity
+    scaling happens on host, exactly as in run_periodic), so there is
+    no cross-device reduction at all and the result is bit-identical
+    to the single-device engine: the vmapped body is the same integer
+    computation per window (tests/test_parallel.py pins it on the
+    8-device virtual mesh). Windows short of the mesh size are padded
+    with repeats of the last window; padded outputs are dropped."""
+    mesh = mesh or build_mesh()
+    from ..sampler.periodic import _compiled_nest_batch, run_periodic
+
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    n_dev = mesh.devices.size
+
+    def window_eval(prog, nest_index, nt, merged):
+        _, batch_kernels = _compiled_nest_batch(
+            prog, nest_index, machine, max_share
+        )
+        outs: dict = {}
+        for pair in (True, False):
+            items = [
+                (key, v0) for key, v0 in merged.items()
+                if (key[0] is not None) == pair
+            ]
+            if not items:
+                continue
+            v0a = np.array([v0 for _, v0 in items], dtype=np.int64)
+            v0b = np.array(
+                [v0 + (key[0] or 0) for key, v0 in items],
+                dtype=np.int64,
+            )
+            pad = (-len(items)) % n_dev
+            if pad:
+                v0a = np.concatenate([v0a, np.repeat(v0a[-1:], pad)])
+                v0b = np.concatenate([v0b, np.repeat(v0b[-1:], pad)])
+            out = jax.device_get(batch_kernels[pair](
+                jax.device_put(v0a, sharding),
+                jax.device_put(v0b, sharding),
+            ))
+            for i, (key, _v0) in enumerate(items):
+                outs[key] = tuple(o[i] for o in out)
+        return outs
+
+    return run_periodic(program, machine, max_share,
+                        window_eval=window_eval)
+
+
+def run_analytic_sharded(
+    program: Program,
+    machine: MachineConfig,
+    mesh: jax.sharding.Mesh | None = None,
+    batch: int | None = None,
+    seed: int = 0,
+    host_cutoff: int | None = None,
+):
+    """Analytic exact engine with every classify dispatch's key axis
+    on the mesh (sampler/analytic.py::_classify_keys): each key's
+    closed-form solve is independent, so GSPMD partitions the
+    period/row-block mega-dispatches with no cross-device traffic and
+    the positionally reassembled outputs — and hence the fits, the
+    folds, everything downstream — are bit-identical to the
+    single-device engine (tests/test_parallel.py). Nests under the
+    host-fold cutoff stay on the host lexsort (no device work exists
+    to shard there); pass host_cutoff=0 to force the sharded engine
+    path."""
+    mesh = mesh or build_mesh()
+    from ..sampler.analytic import run_analytic
+
+    return run_analytic(program, machine, batch=batch, seed=seed,
+                        mesh=mesh, host_cutoff=host_cutoff)
+
+
+def run_exact_sharded(
+    program: Program,
+    machine: MachineConfig,
+    mesh: jax.sharding.Mesh | None = None,
+    max_share: int = 64,
+):
+    """The exact router (periodic -> analytic -> dense) with whichever
+    engine it picks running mesh-sharded; `res.engine` records the
+    choice, same contract as sampler/periodic.py::run_exact."""
+    mesh = mesh or build_mesh()
+    from ..sampler.periodic import run_exact
+
+    return run_exact(program, machine, max_share, mesh=mesh)
 
 
 def run_dense_sharded(

@@ -43,12 +43,17 @@ the closed-form restructuring the TPU design buys — the same move that
 turned the r10 walk into vectorized next-use solves (sampler/
 sampled.py), applied to the exact path.
 
-Multi-chip note: this engine deliberately has no sharded variant. Its
-entire device workload is 2-3 windows of one sort each — there is no
-long axis to lay over a mesh, which is precisely why it is fast. The
-mesh-parallel exact paths are run_dense_sharded (simulated-thread axis
-over devices) and the sampled engine's sample-axis shard_map
-(parallel/sharded.py); programs this engine rejects fall back to them.
+Multi-chip note: the sharded variant
+(parallel/sharded.py::run_periodic_sharded) stacks the merged windows
+of a nest on one axis (jax.vmap of the same kernel body) and lays that
+axis over the mesh — each device evaluates its windows, outputs come
+back per window (no cross-window reduction exists to fuse), and the
+result is bit-identical to the single-device loop because the vmapped
+body is the same integer computation. The axis is short (2-3 windows
+per nest at one machine geometry; more across phase classes), so the
+win is latency overlap, not throughput — the engine's absolute cost is
+tiny either way; the sharded form exists so the exact path has the
+same mesh-native execution story as the approximate engines.
 """
 
 from __future__ import annotations
@@ -378,13 +383,19 @@ def _signatures(nt: NestTrace, tid: int):
     return {k: (v[0], v[1]) for k, v in out.items()}
 
 
-def _window_kernel(nt: NestTrace, max_share: int, pair: bool):
-    """jit: (v0a, v0b) -> histogram contributions of one window.
+def _window_kernel_body(nt: NestTrace, max_share: int, pair: bool):
+    """(v0a, v0b) -> histogram contributions of one window, untraced.
 
     Window-relative positions (mrel 0/1) keep the packed keys narrow:
     grp_bits + ceil_log2(2 * period) + ref bits, independent of N's
     full trace length — which is what lets the periodic engine run at
     sizes whose full packed keys would not fit 63 bits.
+
+    The body is exposed un-jitted so the single-window form
+    (_window_kernel) and the mesh-sharded batched form (jax.vmap over
+    a stacked window axis, parallel/sharded.py::run_periodic_sharded)
+    trace the SAME integer computation — the bit-identity contract
+    between them reduces to vmap semantics.
     """
     t = nt.tables
     a0 = int(t.acc_per_level[0])
@@ -394,7 +405,6 @@ def _window_kernel(nt: NestTrace, max_share: int, pair: bool):
     assert grp_bits + pos_bits + _REF_BITS <= 63, "window key overflow"
     n_m = 2 if pair else 1
 
-    @jax.jit
     def kernel(v0a, v0b):
         v0 = jnp.stack([v0a, v0b])[:n_m].astype(jnp.int64)
         mrel = jnp.arange(n_m, dtype=jnp.int64)
@@ -443,6 +453,11 @@ def _window_kernel(nt: NestTrace, max_share: int, pair: bool):
     return kernel
 
 
+def _window_kernel(nt: NestTrace, max_share: int, pair: bool):
+    """jit: (v0a, v0b) -> histogram contributions of one window."""
+    return jax.jit(_window_kernel_body(nt, max_share, pair))
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_nest(program: Program, nest_index: int,
                    machine: MachineConfig, max_share: int):
@@ -454,6 +469,21 @@ def _compiled_nest(program: Program, nest_index: int,
     }
 
 
+@functools.lru_cache(maxsize=32)
+def _compiled_nest_batch(program: Program, nest_index: int,
+                         machine: MachineConfig, max_share: int):
+    """Batched twins of _compiled_nest's window kernels: jit(vmap) over
+    a stacked window axis, the form whose input axis a mesh lays over
+    devices (parallel/sharded.py). Same body as the scalar kernels, so
+    every output is the same integer computation per window."""
+    trace = _validate_nest(program, nest_index, machine)
+    nt = trace.nests[nest_index]
+    return nt, {
+        pair: jax.jit(jax.vmap(_window_kernel_body(nt, max_share, pair)))
+        for pair in (True, False)
+    }
+
+
 def validate_periodic(program: Program, machine: MachineConfig) -> None:
     """Raise NotImplementedError if any nest fails the preconditions."""
     for k in range(len(program.nests)):
@@ -461,7 +491,7 @@ def validate_periodic(program: Program, machine: MachineConfig) -> None:
 
 
 def run_exact(program: Program, machine: MachineConfig,
-              max_share: int = 64) -> OracleResult:
+              max_share: int = 64, mesh=None) -> OracleResult:
     """Fastest applicable exact engine: periodic when its
     preconditions hold, then the analytic closed-form engine
     (sampler/analytic.py — covers the periodic rejections: triangular
@@ -469,7 +499,19 @@ def run_exact(program: Program, machine: MachineConfig,
     auto-route covers the memory ceiling by falling to stream. All of
     them produce bit-identical PRIStates (tests), so callers wanting
     "the exact histogram, fast" need no engine knowledge. The CLI's
-    `--engine exact` is this function."""
+    `--engine exact` is this function.
+
+    Bit-identity across all routes is PROVEN for the model families
+    pinned in tests/test_analytic.py (+ tools/verify_analytic.py
+    recorded audits); a new program family routed to the analytic
+    engine inherits its probe-backed (not proven) exactness — see the
+    verification ledger in sampler/analytic.py.
+
+    `mesh` (a 1-D jax.sharding.Mesh) runs whichever engine the router
+    picks in its mesh-sharded form — bit-identical to the single-device
+    run (tests/test_parallel.py); `--shard` on the CLI is this
+    parameter. The dense fallback shards only when the mesh size
+    divides thread_num (its mesh axis is the simulated-thread axis)."""
     try:
         validate_periodic(program, machine)
     except NotImplementedError:
@@ -480,22 +522,45 @@ def run_exact(program: Program, machine: MachineConfig,
         except NotImplementedError:
             from .dense import run_dense
 
-            res = run_dense(program, machine, max_share)
+            if (
+                mesh is not None
+                and machine.thread_num % mesh.devices.size == 0
+            ):
+                from ..parallel.sharded import run_dense_sharded
+
+                res = run_dense_sharded(
+                    program, machine, mesh=mesh, max_share=max_share
+                )
+            else:
+                res = run_dense(program, machine, max_share)
             # run_dense itself may have auto-routed past its memory
             # ceiling; it reports nothing, so the label stays coarse
             res.engine = "dense"
             return res
-        res = run_analytic(program, machine)
+        res = run_analytic(program, machine, mesh=mesh)
         res.engine = "analytic"
         return res
-    res = run_periodic(program, machine, max_share)
+    if mesh is not None:
+        from ..parallel.sharded import run_periodic_sharded
+
+        res = run_periodic_sharded(program, machine, mesh, max_share)
+    else:
+        res = run_periodic(program, machine, max_share)
     res.engine = "periodic"
     return res
 
 
 def run_periodic(program: Program, machine: MachineConfig,
-                 max_share: int = 64) -> OracleResult:
-    """Periodic exact engine -> host PRIState (== run_dense exactly)."""
+                 max_share: int = 64, window_eval=None) -> OracleResult:
+    """Periodic exact engine -> host PRIState (== run_dense exactly).
+
+    `window_eval(program, nest_index, nt, merged) -> {key: outputs}` is
+    the evaluation hook the mesh-sharded path plugs in
+    (parallel/sharded.py::run_periodic_sharded lays the merged window
+    axis over the devices); the default evaluates each merged window as
+    one scalar-kernel call. Either way the per-window outputs — and
+    hence the folded state — are the same integer results.
+    """
     P = machine.thread_num
     state = PRIState(P)
     per_tid = [0] * P
@@ -510,13 +575,16 @@ def run_periodic(program: Program, machine: MachineConfig,
             per_tid_sigs.append(sigs)
             for key, (v0_rep, _) in sigs.items():
                 merged.setdefault(key, v0_rep)
-        outs = {}
-        for (delta, _ph), v0_rep in merged.items():
-            pair = delta is not None
-            v0b = v0_rep + (delta if pair else 0)
-            outs[(delta, _ph)] = jax.device_get(
-                kernels[pair](jnp.int64(v0_rep), jnp.int64(v0b))
-            )
+        if window_eval is not None:
+            outs = window_eval(program, k, nt, merged)
+        else:
+            outs = {}
+            for (delta, _ph), v0_rep in merged.items():
+                pair = delta is not None
+                v0b = v0_rep + (delta if pair else 0)
+                outs[(delta, _ph)] = jax.device_get(
+                    kernels[pair](jnp.int64(v0_rep), jnp.int64(v0b))
+                )
         for tid in range(P):
             h = state.noshare[tid]
             hs_all = state.share[tid]

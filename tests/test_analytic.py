@@ -45,8 +45,12 @@ def _dump(state):
     ("gemm", 24),        # rectangular control
 ])
 def test_analytic_bit_exact_vs_oracle(model, n):
+    # host_cutoff=0 forces the period/fit ENGINE path at these small
+    # sizes (the default would fold them through the host lexsort,
+    # which is the oracle itself — exact, but not the machinery under
+    # test here; the default route is covered below)
     prog = REGISTRY[model](n)
-    a = run_analytic(prog, MACHINE, batch=1 << 12)
+    a = run_analytic(prog, MACHINE, batch=1 << 12, host_cutoff=0)
     o = run_numpy(prog, MACHINE)
     assert a.total_accesses == o.total_accesses
     assert _dump(a.state) == _dump(o.state)
@@ -57,9 +61,63 @@ def test_analytic_odd_geometry():
     change the class structure (chunk positions, tails)."""
     m = MachineConfig(thread_num=3, chunk_size=5)
     prog = REGISTRY["syrk-tri"](26)
-    a = run_analytic(prog, m, batch=1 << 12)
+    a = run_analytic(prog, m, batch=1 << 12, host_cutoff=0)
     o = run_numpy(prog, m)
     assert _dump(a.state) == _dump(o.state)
+
+
+def test_analytic_host_fold_default_routes_small_nests():
+    """Nests under the host-fold cutoff take the host lexsort (the
+    numpy oracle's own code) — same bits, milliseconds instead of
+    per-ref kernel costs. Both routes must agree with the oracle AND
+    each other."""
+    prog = REGISTRY["syrk"](24)
+    o = run_numpy(prog, MACHINE)
+    a_host = run_analytic(prog, MACHINE, batch=1 << 12)  # default
+    a_engine = run_analytic(prog, MACHINE, batch=1 << 12, host_cutoff=0)
+    assert _dump(a_host.state) == _dump(o.state)
+    assert _dump(a_engine.state) == _dump(a_host.state)
+    assert a_host.total_accesses == o.total_accesses
+
+
+@pytest.mark.parametrize("model,kw", [
+    ("adi", {}),          # the round-5 crawl case: 4 nests/tstep, 18
+    ("adi", {"tsteps": 2}),  # distinct ref structures, descending loops
+    ("fdtd-2d", {"tsteps": 2}),  # 4 nests/tstep incl. a constant ref
+])
+def test_analytic_batched_stencils_bit_exact(model, kw):
+    """Multi-nest stencils through run_analytic's batched dispatch:
+    the adi class crawled at one dispatch per (ref, period) before the
+    round-6 batching (52.9 s at N=20); the acceptance bar is exactness
+    at interactive speed. Checks BOTH routes: the default (host fold
+    at these sizes) and the forced engine path whose period blocks are
+    the batched mega-dispatches."""
+    prog = REGISTRY[model](12, **kw)
+    o = run_numpy(prog, MACHINE)
+    a = run_analytic(prog, MACHINE)
+    assert a.total_accesses == o.total_accesses
+    assert _dump(a.state) == _dump(o.state)
+    a2 = run_analytic(prog, MACHINE, batch=1 << 12, host_cutoff=0)
+    assert _dump(a2.state) == _dump(o.state)
+
+
+def test_exact_router_adi_is_fast_and_exact():
+    """The acceptance case pinned as a regression guard: run_exact on
+    adi N=20 must route to analytic, match the oracle bit for bit, and
+    stay interactive (the pre-round-6 crawl was ~50 s; the bound here
+    is generous against CI noise while catching any return of
+    per-period dispatch)."""
+    import time
+
+    prog = REGISTRY["adi"](20)
+    t0 = time.perf_counter()
+    r = run_exact(prog, MACHINE)
+    wall = time.perf_counter() - t0
+    assert r.engine == "analytic"
+    o = run_numpy(prog, MACHINE)
+    assert r.total_accesses == o.total_accesses
+    assert _dump(r.state) == _dump(o.state)
+    assert wall < 5.0, f"adi N=20 exact path took {wall:.1f}s"
 
 
 def test_exact_router_covers_rejected_classes():
@@ -97,7 +155,7 @@ def test_analytic_fuzz_models_geometries(seed):
         chunk_size=int(rng.integers(2, 7)),
     )
     prog = REGISTRY[model](n)
-    a = run_analytic(prog, m, batch=1 << 14)
+    a = run_analytic(prog, m, batch=1 << 14, host_cutoff=0)
     o = run_numpy(prog, m)
     assert a.total_accesses == o.total_accesses, (model, n)
     assert _dump(a.state) == _dump(o.state), (model, n)
@@ -109,7 +167,7 @@ def test_analytic_count_identity_guard():
     oracle total exactly (this is the cheap always-on invariant that
     keeps a wrong count formula from passing silently)."""
     prog = REGISTRY["syrk"](32)
-    a = run_analytic(prog, MACHINE, batch=1 << 12)
+    a = run_analytic(prog, MACHINE, batch=1 << 12, host_cutoff=0)
     # total accesses == sum over state of... the state holds weighted
     # bins; the invariant surfaced here is the total access count
     assert a.total_accesses == run_numpy(prog, MACHINE).total_accesses

@@ -120,26 +120,94 @@ def test_emit_result_survives_tail_capture(tmp_path, capsys):
     assert doc["value"] == 5.13e6 and doc["vs_baseline"] == 158.4
     assert doc["device"] == "cpu"
     assert doc["periodic_exact_vs"] == 113.71
-    assert doc["evidence"] == bench.EVIDENCE_SIDECAR
+    # stamped sidecar: the headline names THIS run's evidence file
+    assert doc["evidence"].startswith("BENCH_EVIDENCE_")
     assert len(line.encode()) <= bench.HEADLINE_MAX_BYTES
     # the full record is still available: earlier stdout line + sidecar
     full = json.loads(out.strip().splitlines()[0])
     assert full["extra"]["blob"] == extra["blob"]
-    sidecar = json.loads(
-        (tmp_path / bench.EVIDENCE_SIDECAR).read_text()
-    )
+    sidecar = json.loads((tmp_path / doc["evidence"]).read_text())
     assert sidecar == full
+    # the fixed name stays a `latest` pointer to the stamped file
+    latest = tmp_path / bench.EVIDENCE_SIDECAR
+    if latest.is_symlink():
+        assert json.loads(latest.read_text()) == full
+    else:
+        assert json.loads(latest.read_text()) == {
+            "latest": doc["evidence"]
+        }
 
 
-def test_bench_emits_json_line():
+def test_emit_result_back_to_back_runs_do_not_clobber(tmp_path, capsys):
+    """Two invocations keep two evidence files, each headline naming
+    its own (round-5 weak point 4: one fixed sidecar held whichever
+    run wrote last while every headline pointed at it)."""
+    lines = [
+        bench.emit_result(
+            {"metric": m, "value": v, "unit": "samples/s/chip",
+             "vs_baseline": 1.0},
+            {"device": "cpu", "v": v}, sidecar_dir=str(tmp_path),
+        )
+        for m, v in (("gemm64_sampled_throughput", 1.0),
+                     ("syrk64_exact_throughput", 2.0))
+    ]
+    refs = [json.loads(l)["evidence"] for l in lines]
+    assert refs[0] != refs[1]
+    for ref, v in zip(refs, (1.0, 2.0)):
+        assert json.loads((tmp_path / ref).read_text())["value"] == v
+
+
+def test_emit_result_enforces_headline_cap(tmp_path, capsys):
+    """Oversized REQUIRED fields (the drop loop only removes optional
+    keys) must truncate down to the <500-byte contract, not silently
+    overrun it (ADVICE round 5, low #3)."""
+    line = bench.emit_result(
+        {"metric": "m" * 2000, "value": 1.0, "unit": "samples/s/chip",
+         "vs_baseline": 1.0},
+        {"device": "cpu"}, sidecar_dir=str(tmp_path),
+    )
+    capsys.readouterr()
+    assert len(line.encode()) <= bench.HEADLINE_MAX_BYTES
+    doc = json.loads(line)  # still one parseable JSON object
+    assert doc["value"] == 1.0
+
+
+def test_emit_result_headline_carries_analytic_secondary(tmp_path, capsys):
+    """The exact-router secondary row's engine label must reach the
+    driver's tail (the headline), not just the full record."""
+    line = bench.emit_result(
+        {"metric": "gemm4096_sampled_throughput", "value": 1.0,
+         "unit": "samples/s/chip", "vs_baseline": 100.0},
+        {"device": "cpu",
+         "analytic_exact": {"model": "syrk", "n": 1024,
+                            "engine": "analytic", "vs_baseline": 4.2}},
+        sidecar_dir=str(tmp_path),
+    )
+    capsys.readouterr()
+    doc = json.loads(line)
+    assert doc["exact_secondary"]["engine"] == "analytic"
+    assert doc["exact_secondary"]["vs_baseline"] == 4.2
+
+
+def test_bench_emits_json_line(tmp_path):
     # marker held absent so --device-timeout is honored end-to-end
-    # (and restored afterward for real bench runs)
+    # (and restored afterward for real bench runs). The analytic
+    # secondary row runs at a small size (the default syrk N=1024
+    # would measure a live serial baseline for minutes here); its
+    # engine label is asserted below.
+    before = set(os.listdir(REPO))
     with _marker_absent():
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
-             "--n", "64", "--device-timeout", "1"],
+             "--n", "64", "--device-timeout", "1",
+             "--exact-model", "syrk", "--exact-n", "64"],
             capture_output=True, text=True, timeout=900, cwd=REPO,
         )
+    # the stamped sidecar (+ refreshed latest pointer) lands next to
+    # bench.py; drop what this test created so repeat runs stay clean
+    for name in set(os.listdir(REPO)) - before:
+        if name.startswith("BENCH_EVIDENCE"):
+            os.remove(os.path.join(REPO, name))
     assert proc.returncode == 0, proc.stderr[-2000:]
     json_lines = [
         l for l in proc.stdout.splitlines() if l.startswith("{")
@@ -152,8 +220,12 @@ def test_bench_emits_json_line():
     assert final["value"] > 0
     assert final["vs_baseline"] > 0
     assert final["device"]
-    assert final["evidence"] == bench.EVIDENCE_SIDECAR
+    assert final["evidence"].startswith("BENCH_EVIDENCE_")
+    # the analytic secondary row reaches the tail with its engine label
+    assert final["exact_secondary"]["engine"] == "analytic"
     doc = json.loads(json_lines[0])  # the full record
+    assert doc["extra"]["analytic_exact"]["engine"] == "analytic"
+    assert doc["extra"]["analytic_exact"]["mrc_l1_err"] == 0.0
     assert doc["unit"] == "samples/s/chip"
     assert doc["value"] == final["value"]
     assert doc["vs_baseline"] > 0  # native baseline must have run

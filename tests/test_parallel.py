@@ -192,6 +192,99 @@ def test_sampled_sharded_triangular_matches_unsharded():
         assert a.cold == b.cold
 
 
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_periodic_sharded_matches_unsharded(n_dev):
+    """Exact periodic engine with the merged-window axis over the
+    mesh: bit-identical PRIState to the single-device loop (the
+    vmapped window body is the same integer computation)."""
+    from pluss_sampler_optimization_tpu.parallel import (
+        run_periodic_sharded,
+    )
+    from pluss_sampler_optimization_tpu.sampler.periodic import (
+        run_periodic,
+    )
+
+    prog = gemm(16)
+    ref = run_periodic(prog, MACHINE)
+    sh = run_periodic_sharded(prog, MACHINE, build_mesh(n_dev))
+    assert ref.total_accesses == sh.total_accesses
+    assert ref.per_tid_accesses == sh.per_tid_accesses
+    _states_equal(ref.state, sh.state)
+
+
+def test_periodic_sharded_multiphase_windows():
+    """A non-pow2 stencil size produces multiple phase classes (more
+    merged windows than devices on a small mesh — exercises padding
+    and >1 window per device)."""
+    from pluss_sampler_optimization_tpu.models import jacobi2d
+    from pluss_sampler_optimization_tpu.parallel import (
+        run_periodic_sharded,
+    )
+    from pluss_sampler_optimization_tpu.sampler.periodic import (
+        run_periodic,
+    )
+
+    prog = jacobi2d(37)
+    ref = run_periodic(prog, MACHINE)
+    sh = run_periodic_sharded(prog, MACHINE, build_mesh(8))
+    _states_equal(ref.state, sh.state)
+
+
+@pytest.mark.parametrize("model_n", [("syrk_rect", 24), ("syrk_tri", 24)])
+def test_analytic_sharded_matches_unsharded(model_n):
+    """Analytic exact engine with its classify key axis GSPMD-sharded
+    over the mesh: bit-identical to single-device. host_cutoff=0
+    forces the engine path — at these sizes the default host-lexsort
+    shortcut would leave no device dispatch to shard."""
+    import pluss_sampler_optimization_tpu.models as models
+    from pluss_sampler_optimization_tpu.parallel import (
+        run_analytic_sharded,
+    )
+    from pluss_sampler_optimization_tpu.sampler.analytic import (
+        run_analytic,
+    )
+
+    name, n = model_n
+    prog = getattr(models, name)(n)
+    ref = run_analytic(prog, MACHINE, batch=1 << 12, host_cutoff=0)
+    sh = run_analytic_sharded(
+        prog, MACHINE, build_mesh(8), batch=1 << 12, host_cutoff=0
+    )
+    assert ref.total_accesses == sh.total_accesses
+    _states_equal(ref.state, sh.state)
+
+
+def test_exact_sharded_router_matches_and_labels():
+    """run_exact_sharded routes like run_exact (periodic for gemm,
+    analytic for the periodic-rejected syrk family), labels the
+    engine, and stays bit-identical to the unsharded router."""
+    from pluss_sampler_optimization_tpu.models import syrk_rect, syrk_tri
+    from pluss_sampler_optimization_tpu.parallel import run_exact_sharded
+    from pluss_sampler_optimization_tpu.sampler.periodic import run_exact
+
+    mesh = build_mesh(8)
+    for prog, want in ((gemm(16), "periodic"),
+                       (syrk_rect(16), "analytic"),
+                       (syrk_tri(12), "analytic")):
+        ref = run_exact(prog, MACHINE)
+        sh = run_exact_sharded(prog, MACHINE, mesh)
+        assert ref.engine == sh.engine == want
+        assert ref.total_accesses == sh.total_accesses
+        _states_equal(ref.state, sh.state)
+
+
+def test_cli_shard_flag():
+    """--shard runs the exact router mesh-sharded through the CLI and
+    is rejected for engines without a sharded exact form."""
+    from pluss_sampler_optimization_tpu.cli import main
+
+    assert main(["acc", "--model", "syrk", "--n", "16",
+                 "--engine", "exact", "--shard"]) == 0
+    with pytest.raises(SystemExit, match="--shard applies"):
+        main(["acc", "--model", "gemm", "--n", "8",
+              "--engine", "dense", "--shard"])
+
+
 def test_distributed_single_process_mesh():
     """initialize_distributed + build_global_mesh in the degenerate
     single-process setting. jax.distributed must come up before any

@@ -139,8 +139,11 @@ def main() -> int:
     if bad:
         return 1
     # end-to-end: the production entry point (v0-level class fits
-    # included) must equal the all-periods-direct fold above
-    eng = A.run_analytic(prog, machine, batch=batch)
+    # included) must equal the all-periods-direct fold above.
+    # host_cutoff=0 forces the fit machinery — the audit exists to
+    # exercise it; the default host-lexsort shortcut for small nests
+    # is the oracle's own code and needs no audit
+    eng = A.run_analytic(prog, machine, batch=batch, host_cutoff=0)
 
     def dump(s):
         return (
