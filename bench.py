@@ -41,23 +41,19 @@ _PROBE_TTL_S = 3600.0
 
 
 _PROBE_SCRIPT = """\
-import sys, time
-
-
-def mark(*a):
-    print(*a, flush=True)
-
+import time
 
 t0 = time.time()
 import jax
-mark("IMPORT_OK", round(time.time() - t0, 1))
+print("IMPORT_OK", round(time.time() - t0, 1), flush=True)
 t0 = time.time()
 d = jax.devices()
-mark("DEVICES_OK", round(time.time() - t0, 1), d[0].platform, d[0].device_kind)
+print("DEVICES_OK", round(time.time() - t0, 1), d[0].platform,
+      d[0].device_kind, flush=True)
 t0 = time.time()
 import jax.numpy as jnp
 jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128))).block_until_ready()
-mark("JIT_OK", round(time.time() - t0, 1))
+print("JIT_OK", round(time.time() - t0, 1), flush=True)
 """
 
 
@@ -221,163 +217,11 @@ def guarded_backend_init(
         done.set()
 
 
-def _cpu_features_hash() -> str:
-    """8-hex digest of the host CPU's model + ISA flags.
-
-    XLA:CPU AOT cache entries bake in machine features INCLUDING
-    tuning pseudo-features (prefer-no-gather/prefer-no-scatter) that
-    are not part of the cache key; loading an entry compiled on a
-    different host logs 'machine type ... doesn't match' warnings,
-    risks SIGILL, and silently skews timings (gather/scatter-averse
-    codegen on a gather-heavy engine). The CPU-fallback bench scopes
-    its cache dir by this hash so executables never cross hosts; the
-    model+flags lines cover every input XLA's feature detection uses.
-    """
-    import hashlib
-    import platform
-
-    try:
-        with open("/proc/cpuinfo") as f:
-            txt = f.read()
-    except OSError:
-        txt = ""
-    lines = [
-        ln for ln in txt.splitlines()
-        # x86 naming first; ARM and friends spell identity differently
-        # ('Features', 'CPU implementer', ...) — match those stable
-        # identity lines explicitly rather than hashing the whole first
-        # block, which contains per-boot-calibrated fields (BogoMIPS,
-        # cpu MHz on some kernels) that would churn the scoped cache
-        # dir across boots for no codegen-relevant reason
-        if ln.startswith((
-            "model name", "flags",
-            "Features", "CPU implementer", "CPU architecture",
-            "CPU variant", "CPU part", "CPU revision",
-        ))
-    ]
-    # /proc/cpuinfo repeats identity lines once per logical CPU; dedupe
-    # so the digest is invariant to the visible core count (two
-    # containers on the same CPU model must share a cache dir)
-    lines = list(dict.fromkeys(lines))[:8]
-    # last resort (exotic /proc/cpuinfo): the whole first block, minus
-    # lines with known per-boot fields
-    ident = "\n".join(lines) if lines else "\n".join(
-        ln for ln in txt.split("\n\n")[0].splitlines()
-        if not ln.lower().startswith(("bogomips", "cpu mhz"))
-    )
-    ident += "|" + platform.machine()
-    return hashlib.sha256(ident.encode()).hexdigest()[:8]
-
-
-def _host_fingerprint() -> dict:
-    """Identity + speed of the host the bench actually ran on.
-
-    Round 3's driver run and the builder's validation run measured
-    76.65 s vs 57.7 s on identical code with cpu_wall ~1.0 on both —
-    a 33% spread with a clean contention signal, meaning the remaining
-    confounders (CPU model/frequency, container placement) were
-    unrecorded. This block records them: /proc/cpuinfo identity,
-    boot/machine ids (same-container detection), and a measured
-    speed probe — a fixed numpy workload (int64 sort + matmul, the
-    engine's two dominant CPU primitives) whose wall time directly
-    ranks hosts even when nominal frequencies lie (VMs pin cpu MHz
-    to a constant).
-    """
-    import numpy as np
-
-    fp: dict = {}
-    try:
-        with open("/proc/cpuinfo") as f:
-            txt = f.read()
-        for key, tag in (("model name", "cpu_model"),
-                         ("cpu MHz", "cpu_mhz"),
-                         ("bogomips", "bogomips")):
-            for line in txt.splitlines():
-                if line.startswith(key):
-                    fp[tag] = line.split(":", 1)[1].strip()
-                    break
-    except OSError:
-        pass
-    for path, tag in (("/proc/sys/kernel/random/boot_id", "boot_id"),
-                      ("/etc/machine-id", "machine_id")):
-        try:
-            with open(path) as f:
-                fp[tag] = f.read().strip()
-        except OSError:
-            pass
-    try:
-        import socket
-
-        fp["hostname"] = socket.gethostname()
-    except OSError:
-        pass
-    fp["cpu_features_hash"] = _cpu_features_hash()
-    # measured speed: fixed work, wall-timed. ~0.5 s on the round-3
-    # validation host; a slower CPU model shows up here as a
-    # proportionally larger number even when cpu_wall stays at 1.0.
-    rng = np.random.default_rng(0)
-    vals = rng.integers(0, 1 << 62, size=1 << 21, dtype=np.int64)
-    mat = rng.standard_normal((256, 256))
-    t0 = time.perf_counter()
-    for _ in range(4):
-        np.sort(vals)
-    acc = mat
-    for _ in range(8):
-        acc = acc @ mat
-    fp["speed_probe_s"] = round(time.perf_counter() - t0, 3)
-    return fp
-
-
-_live_compile_counters: dict | None = None
-
-
-def _register_compile_counters() -> dict:
-    """Count persistent-compile-cache hits/misses and backend compile
-    seconds via jax.monitoring, so a bench row records whether its
-    warm-up was served from .jax_cache or paid for real compiles —
-    cold-cache state was one of the unrecorded confounders behind the
-    round-3 driver-vs-validation spread. Call AFTER `import jax` and
-    BEFORE the first backend touch; returns the live counter dict.
-    Listeners are process-global and cannot be unregistered, so a
-    second call returns the already-registered counters instead of
-    double-counting."""
-    global _live_compile_counters
-    if _live_compile_counters is not None:
-        return _live_compile_counters
-    import jax
-
-    counters = {
-        "cache_hits": 0, "cache_misses": 0, "compile_requests": 0,
-        "backend_compile_s": 0.0, "backend_compiles": 0,
-    }
-
-    def on_event(key, **kw):
-        if key == "/jax/compilation_cache/cache_hits":
-            counters["cache_hits"] += 1
-        elif key == "/jax/compilation_cache/cache_misses":
-            counters["cache_misses"] += 1
-        elif key == "/jax/compilation_cache/compile_requests_use_cache":
-            counters["compile_requests"] += 1
-
-    def on_duration(key, dur, **kw):
-        if key == "/jax/core/compile/backend_compile_duration":
-            # raw accumulation; rounding happens once at JSON emission
-            # (_snap_counters) so per-event rounding error never piles up
-            counters["backend_compile_s"] += dur
-            counters["backend_compiles"] += 1
-
-    jax.monitoring.register_event_listener(on_event)
-    jax.monitoring.register_event_duration_secs_listener(on_duration)
-    _live_compile_counters = counters
-    return counters
-
-
-def _snap_counters(counters: dict) -> dict:
-    """JSON-ready snapshot of the live compile counters."""
-    snap = dict(counters)
-    snap["backend_compile_s"] = round(snap["backend_compile_s"], 2)
-    return snap
-
+# Host fingerprint, CPU-features hash, cgroup throttle reads, and the
+# jax.monitoring compile counters all moved to the shared telemetry
+# layer (pluss_sampler_optimization_tpu/runtime/telemetry.py) — this
+# script consumes them like any other caller. Imported lazily inside
+# main() so the probe/watchdog path stays import-light.
 
 EVIDENCE_SIDECAR = "BENCH_EVIDENCE.json"  # `latest` pointer, kept stable
 HEADLINE_MAX_BYTES = 500
@@ -385,12 +229,14 @@ HEADLINE_MAX_BYTES = 500
 _RUN_SEQ = [0]  # process-local tiebreak: same-second same-pid calls
 
 
-def _stamped_sidecar_name(metric: str) -> str:
-    """Per-run evidence filename: metric + run id (UTC timestamp, pid,
+def _stamped_sidecar_name(metric: str,
+                          prefix: str = "BENCH_EVIDENCE") -> str:
+    """Per-run sidecar filename: metric + run id (UTC timestamp, pid,
     in-process sequence). Back-to-back or concurrent bench invocations
     each keep their own evidence instead of clobbering one shared file
     — round 5's on-disk BENCH_EVIDENCE.json held a different run than
-    the headline pointing at it (VERDICT weak #4)."""
+    the headline pointing at it (VERDICT weak #4). The telemetry
+    sidecar uses the same scheme under the BENCH_TELEMETRY prefix."""
     import re
 
     safe = re.sub(r"[^A-Za-z0-9._-]+", "-", metric)[:60]
@@ -399,7 +245,7 @@ def _stamped_sidecar_name(metric: str) -> str:
         time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
         os.getpid(), _RUN_SEQ[0],
     )
-    return f"BENCH_EVIDENCE_{safe}_{rid}.json"
+    return f"{prefix}_{safe}_{rid}.json"
 
 
 def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
@@ -498,24 +344,6 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
     )
     print(line, file=out)
     return line
-
-
-def _read_cpu_throttle():
-    """cgroup-v2 CPU throttle counters, or None when unreadable. A
-    contended/quota-limited container shows up here even when loadavg
-    looks calm."""
-    try:
-        with open("/sys/fs/cgroup/cpu.stat") as f:
-            d = dict(
-                line.split() for line in f if len(line.split()) == 2
-            )
-        return {
-            k: int(d[k])
-            for k in ("nr_throttled", "throttled_usec")
-            if k in d
-        }
-    except (OSError, ValueError):
-        return None
 
 
 def _bench_hist_kernel_on_device() -> dict:
@@ -693,6 +521,25 @@ def main() -> int:
 
     import jax
 
+    from pluss_sampler_optimization_tpu.runtime import telemetry
+
+    # register the monitoring listeners BEFORE the first backend touch
+    # (so warm-up compiles are counted), then start the bench's
+    # telemetry run; the full record ships as a stamped sidecar next
+    # to the evidence files and summarizes on stderr at exit.
+    try:
+        telemetry.register_jax_hooks()
+        have_counters = True
+    except Exception:
+        have_counters = False
+    tele = telemetry.enable()
+    telemetry.event(
+        "accel_probe",
+        fallback=device_fallback,
+        cached=probe_was_cached,
+        attempts=len([e for e in probe_evidence if "attempt" in e]),
+    )
+
     if device_fallback:
         # The env may pin JAX_PLATFORMS to an accelerator plugin from
         # sitecustomize before this process's code runs; the config
@@ -727,14 +574,12 @@ def main() -> int:
         try:
             jax.config.update(
                 "jax_compilation_cache_dir",
-                os.path.join(cache_dir, "cpu-" + _cpu_features_hash()),
+                os.path.join(
+                    cache_dir, "cpu-" + telemetry.cpu_features_hash()
+                ),
             )
         except Exception:
             pass
-    try:  # compile-cache hit/miss evidence for the bench JSON
-        compile_counters = _register_compile_counters()
-    except Exception:
-        compile_counters = None
 
     from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
     from pluss_sampler_optimization_tpu.models import REGISTRY
@@ -807,17 +652,21 @@ def main() -> int:
     t0 = time.perf_counter()
 
     def first_touch():
-        stamps["dev"] = jax.devices()[0]
+        with telemetry.span("backend_init"):
+            stamps["dev"] = jax.devices()[0]
         stamps["init_s"] = time.perf_counter() - t0
         _scope_cache_for_backend(str(stamps["dev"].platform))
         t1 = time.perf_counter()
-        if args.engine == "sampled":
-            warmup(prog, machine, cfg)
-        else:
-            timed_engine_run()
+        with telemetry.span("warmup", engine=args.engine):
+            if args.engine == "sampled":
+                warmup(prog, machine, cfg)
+            else:
+                timed_engine_run()
         stamps["warmup_s"] = time.perf_counter() - t1
-        if compile_counters is not None:
-            stamps["warmup_compiles"] = _snap_counters(compile_counters)
+        if have_counters:
+            stamps["warmup_compiles"] = (
+                telemetry.compile_counters_snapshot()
+            )
 
     if (
         not device_fallback
@@ -840,11 +689,12 @@ def main() -> int:
 
     times = []
     rep_stats = []
-    throttle0 = _read_cpu_throttle()
-    for _ in range(max(1, args.reps)):
+    throttle0 = telemetry.read_cpu_throttle()
+    for rep_i in range(max(1, args.reps)):
         t0 = time.perf_counter()
         c0 = time.process_time()
-        state, work = timed_engine_run()
+        with telemetry.span("rep", i=rep_i, engine=args.engine):
+            state, work = timed_engine_run()
         w = time.perf_counter() - t0
         c = time.process_time() - c0
         times.append(w)
@@ -860,7 +710,7 @@ def main() -> int:
     # read immediately after the reps loop: the fingerprint's CPU speed
     # probe below would otherwise add its own throttle events to a
     # delta meant to characterize only the timed rep window
-    throttle1 = _read_cpu_throttle()
+    throttle1 = telemetry.read_cpu_throttle()
     t_tpu = sorted(times)[len(times) // 2]  # median
 
     unit_name = "samples" if args.engine == "sampled" else "accesses"
@@ -883,9 +733,9 @@ def main() -> int:
         # ~1.0 yet high wall time) self-identifies as a slower/other
         # host via cpu_model/boot_id/speed_probe_s instead of leaving
         # an unexplained spread (round-3 weak point 1)
-        "host": _host_fingerprint(),
+        "host": telemetry.host_fingerprint(speed_probe=True),
     }
-    if compile_counters is not None:
+    if have_counters:
         # cold vs warm .jax_cache state, split at the warm-up boundary
         cc_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
         extra["compile_cache"] = {
@@ -893,7 +743,7 @@ def main() -> int:
                 cc_dir, os.path.dirname(os.path.abspath(__file__))
             ) if cc_dir else "unset",
             "warmup": stamps.get("warmup_compiles"),
-            "total": _snap_counters(compile_counters),
+            "total": telemetry.compile_counters_snapshot(),
         }
     if throttle0 is not None and throttle1 is not None:
         extra["cgroup_throttle_delta"] = {
@@ -1133,14 +983,32 @@ def main() -> int:
         except Exception as e:  # the headline metric must still print
             extra["second_model_error"] = repr(e)
 
-    if compile_counters is not None and "compile_cache" in extra:
+    if have_counters and "compile_cache" in extra:
         # final snapshot: the extras (periodic_exact, second model) may
         # have compiled too; "total" must mean the whole process
-        extra["compile_cache"]["total"] = _snap_counters(compile_counters)
+        extra["compile_cache"]["total"] = (
+            telemetry.compile_counters_snapshot()
+        )
+
+    metric = f"{args.model}{args.n}_{args.engine}_throughput"
+    # full telemetry record (span tree, counters, jax monitoring delta,
+    # device/host metrics) as a stamped sidecar next to the evidence
+    # files; the evidence JSON names it so the two cross-reference
+    telemetry.disable()
+    tele_name = _stamped_sidecar_name(metric, prefix="BENCH_TELEMETRY")
+    try:
+        tele.write_json(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         tele_name)
+        )
+        extra["telemetry"] = tele_name
+    except OSError:
+        extra["telemetry"] = "unwritable"
+    tele.print_summary()
 
     emit_result(
         {
-            "metric": f"{args.model}{args.n}_{args.engine}_throughput",
+            "metric": metric,
             "value": round(work / t_tpu, 1),
             "unit": f"{unit_name}/s/chip",
             "vs_baseline": round(vs_baseline, 2),

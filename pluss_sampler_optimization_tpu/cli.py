@@ -236,6 +236,25 @@ def main(argv=None) -> int:
         "any backend initializes; plain env vars are too late when a "
         "site pins a TPU plugin (see tests/conftest.py).",
     )
+    ap.add_argument(
+        "--telemetry-out",
+        default=None,
+        metavar="PATH",
+        help="record engine-stage spans, dispatch/fetch counters, "
+        "jax compile events, and device/host metrics for this run and "
+        "write them as structured JSON to PATH (schema: README "
+        "\"Observability\"; validate with "
+        "tools/check_telemetry_schema.py). A compact summary prints "
+        "to stderr. Works in every mode.",
+    )
+    ap.add_argument(
+        "--profile-dir",
+        default=None,
+        metavar="PATH",
+        help="wrap the run in jax.profiler.trace(PATH) and write a "
+        "Perfetto/XLA trace there (open at ui.perfetto.dev or via "
+        "TensorBoard). Independent of --telemetry-out.",
+    )
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -244,9 +263,6 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", args.platform)
 
     from .config import MachineConfig
-    from .runtime import report
-    from .runtime.aet import aet_mrc
-    from .runtime.cri import cri_distribute
 
     machine = MachineConfig(thread_num=args.threads, chunk_size=args.chunk)
     program = _build_model(args.model, args.n, args.tsteps)
@@ -289,6 +305,34 @@ def main(argv=None) -> int:
                 f"(have {', '.join(_ENGINES)})"
             )
 
+    tele = None
+    if args.telemetry_out:
+        from .runtime import telemetry
+
+        tele = telemetry.enable()
+    try:
+        if args.profile_dir:
+            import jax
+
+            with jax.profiler.trace(args.profile_dir):
+                return _execute(args, machine, program, engine)
+        return _execute(args, machine, program, engine)
+    finally:
+        if tele is not None:
+            from .runtime import telemetry
+
+            telemetry.disable()
+            tele.print_summary()
+            tele.write_json(args.telemetry_out)
+
+
+def _execute(args, machine, program, engine) -> int:
+    """Run the selected mode (spans/counters land in the active
+    telemetry run, if any — main() owns enable/export)."""
+    from .runtime import report
+    from .runtime.aet import aet_mrc
+    from .runtime.cri import cri_distribute
+
     if args.mode == "trace":
         # the reference's -DDEBUG access/reuse logs (runtime/debug.py)
         from .core.trace import ProgramTrace
@@ -315,9 +359,10 @@ def main(argv=None) -> int:
     if args.mode == "speed":
         # Makefile:34-37 / main.rs:31-33: repeated timed runs after a
         # cache flush (pluss_timer_start flushes 2.5MB, pluss.cpp:86-94)
+        from .runtime import telemetry
         from .runtime.timing import timed
 
-        times, _ = timed(
+        times, _, flushes = timed(
             lambda: _run_engine(engine, program, machine, args),
             reps=args.reps,
             flush_kb=machine.cache_kb,
@@ -327,6 +372,17 @@ def main(argv=None) -> int:
         print(
             f"{engine} {program.name}: best {min(times):.6f} s, "
             f"mean {sum(times) / len(times):.6f} s over {len(times)} runs"
+        )
+        # flush cost is measured OUTSIDE the per-rep seconds (timed's
+        # contract); surface it so slow-flush hosts are auditable
+        telemetry.gauge(
+            "cache_flush_s_per_rep",
+            round(sum(flushes) / len(flushes), 6),
+        )
+        print(
+            f"{engine} {program.name}: cache-flush overhead "
+            f"{sum(flushes) / len(flushes):.6f} s/rep "
+            "(excluded from the timings above)"
         )
         return 0
 

@@ -48,6 +48,7 @@ from ..ops.histogram import (
     fixed_k_unique,
     merge_pair_sets,
 )
+from ..runtime import telemetry
 from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
 from ..sampler.draw import draw_sample_keys_device
@@ -321,17 +322,22 @@ def sampled_outputs_sharded(
     for idx, (k, ri, kernel, cap) in enumerate(kernels):
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
+        ref_span = telemetry.span("ref", engine="sharded", ref=name)
+        ref_span.__enter__()
         drawn = None
         if use_dev_draw:
-            drawn = draw_sample_keys_device(
-                nt, ri, cfg, seed=cfg.seed * 1000003 + idx, batch=batch
-            )
+            with telemetry.span("draw", where="device"):
+                drawn = draw_sample_keys_device(
+                    nt, ri, cfg, seed=cfg.seed * 1000003 + idx,
+                    batch=batch,
+                )
         if drawn is None:
             # key form until dispatch: a large run holds 1/3 the
             # memory (see draw_sample_keys)
-            keys_all, highs = draw_sample_keys(
-                nt, ri, cfg, seed=cfg.seed * 1000003 + idx
-            )
+            with telemetry.span("draw", where="host"):
+                keys_all, highs = draw_sample_keys(
+                    nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+                )
             n_samples = len(keys_all)
         else:
             dev_keys, dev_mask, n_samples, highs = drawn
@@ -351,17 +357,23 @@ def sampled_outputs_sharded(
             nonlocal cold, dense
             while True:
                 kern, c2 = holder[-2], holder[-1]
-                nh, c, keys, counts, n_unique = jax.device_get(
-                    run_kernel(kern)
-                )
+                with telemetry.span("dispatch_psum"):
+                    telemetry.count("dispatches")
+                    out = run_kernel(kern)
+                with telemetry.span("gather_fetch"):
+                    nh, c, keys, counts, n_unique = (
+                        telemetry.record_fetch(jax.device_get(out))
+                    )
                 if int(n_unique.max(initial=0)) <= c2:
                     break
+                telemetry.count("capacity_regrows")
                 holder[-1] = max(c2 * 4, int(n_unique.max(initial=0)))
                 holder[-2] = rebuild(holder[-1])
             dense += nh
             cold += float(c)
-            for d in range(n_dev):
-                decode_pairs(keys[d], counts[d], noshare, share)
+            with telemetry.span("merge"):
+                for d in range(n_dev):
+                    decode_pairs(keys[d], counts[d], noshare, share)
 
         def _buffer_to_global(buf):
             """The whole (process-local, identical on every process)
@@ -372,7 +384,8 @@ def sampled_outputs_sharded(
             single-device pieces — every process computed the same
             buffer, so the assembly is consistent by determinism."""
             if n_proc == 1:
-                return jax.device_put(buf, in_sharding)
+                with telemetry.span("shard_put", rows=int(buf.shape[0])):
+                    return jax.device_put(buf, in_sharding)
             B = buf.shape[0]
             rows = B // n_dev
             pid = jax.process_index()
@@ -416,10 +429,12 @@ def sampled_outputs_sharded(
                 # for the kernel.
                 rows = len(chunk) // n_proc
                 pid = jax.process_index()
-                cj = jax.make_array_from_process_local_data(
-                    in_sharding, chunk[pid * rows : (pid + 1) * rows],
-                    chunk.shape,
-                )
+                with telemetry.span("shard_put", rows=len(chunk)):
+                    cj = jax.make_array_from_process_local_data(
+                        in_sharding,
+                        chunk[pid * rows : (pid + 1) * rows],
+                        chunk.shape,
+                    )
                 dispatch(
                     kernels[idx],
                     lambda kern, cj=cj, n_valid=n_valid, ph=ph,
@@ -428,6 +443,7 @@ def sampled_outputs_sharded(
                         nt, ri, mesh, c2, cfg.use_pallas_hist, scan=False
                     ),
                 )
+        ref_span.__exit__(None, None, None)
         results.append(
             SampledRefResult(
                 name=name, noshare=noshare, share=share, cold=cold,
@@ -502,10 +518,14 @@ def run_periodic_sharded(
             if pad:
                 v0a = np.concatenate([v0a, np.repeat(v0a[-1:], pad)])
                 v0b = np.concatenate([v0b, np.repeat(v0b[-1:], pad)])
-            out = jax.device_get(batch_kernels[pair](
-                jax.device_put(v0a, sharding),
-                jax.device_put(v0b, sharding),
-            ))
+            with telemetry.span("shard_put", windows=len(v0a)):
+                v0a_d = jax.device_put(v0a, sharding)
+                v0b_d = jax.device_put(v0b, sharding)
+            telemetry.count("dispatches")
+            with telemetry.span("gather_fetch"):
+                out = telemetry.record_fetch(
+                    jax.device_get(batch_kernels[pair](v0a_d, v0b_d))
+                )
             for i, (key, _v0) in enumerate(items):
                 outs[key] = tuple(o[i] for o in out)
         return outs

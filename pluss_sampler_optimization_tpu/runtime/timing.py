@@ -37,7 +37,14 @@ def flush_cache(cache_kb: int = _CACHE_SIZE_KB) -> float:
 
 
 class Timer:
-    """pluss_timer_start/stop/print (pluss.cpp:86-124)."""
+    """pluss_timer_start/stop/print (pluss.cpp:86-124).
+
+    The cache flush runs BEFORE the timed region and its cost is
+    recorded separately (`flush_s`, reset at every start): on hosts
+    where the 2.5 MB walk is slow it must never pollute the measured
+    per-rep seconds, and recording it makes the overhead auditable
+    (`timed` returns the per-rep flush costs alongside the rep times).
+    """
 
     def __init__(self, cycle_accurate: bool = False, flush: bool = True,
                  flush_kb: int = _CACHE_SIZE_KB) -> None:
@@ -46,12 +53,17 @@ class Timer:
         self.flush_kb = flush_kb
         self.elapsed = 0.0
         self.cycles = 0
+        self.flush_s = 0.0
         self._t0 = 0.0
         self._c0 = 0
 
     def start(self) -> None:
         if self.flush:
+            t0 = time.perf_counter()
             flush_cache(self.flush_kb)
+            self.flush_s = time.perf_counter() - t0
+        else:
+            self.flush_s = 0.0
         if self.cycle_accurate:
             self._c0 = time.perf_counter_ns()
         self._t0 = time.perf_counter()
@@ -72,13 +84,20 @@ class Timer:
 
 def timed(fn, reps: int = 1, cycle_accurate: bool = False,
           flush: bool = True, flush_kb: int = _CACHE_SIZE_KB):
-    """Run fn() `reps` times; returns (per-rep seconds, last result)."""
+    """Run fn() `reps` times; returns (per-rep seconds, last result,
+    per-rep cache-flush seconds). The flush cost is measured outside
+    the timed region — per-rep seconds contain only fn() — and
+    returned so callers can audit the flush overhead instead of it
+    silently disappearing (or, worse, leaking into the reps on hosts
+    where the flush walk is slow)."""
     t = Timer(cycle_accurate=cycle_accurate, flush=flush,
               flush_kb=flush_kb)
     times = []
+    flushes = []
     result = None
     for _ in range(reps):
         t.start()
         result = fn()
         times.append(t.stop())
-    return times, result
+        flushes.append(t.flush_s)
+    return times, result, flushes

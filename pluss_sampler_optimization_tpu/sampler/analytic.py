@@ -90,6 +90,7 @@ from ..config import MachineConfig
 from ..core.trace import NestTrace
 from ..ir import Program
 from ..oracle.serial import OracleResult
+from ..runtime import telemetry
 from ..runtime.hist import PRIState
 from .periodic import _phase_count
 from .sampled import (
@@ -103,6 +104,50 @@ from .sampled import (
 
 _MIN_PROBES = 6  # exact evaluations per fitted class (incl. random)
 _COLD_KEY = "cold"
+
+# Model families whose analytic-route exactness is PROVEN — pinned
+# bit-equal vs the oracle across sizes/geometries by
+# tests/test_analytic.py and/or covered by recorded
+# tools/verify_analytic.py audits. `run_exact`'s analytic route warns
+# (stderr + telemetry event) for any family outside this set: those
+# inherit the probe-backed verification ledger (module docstring), not
+# a proof. Names match the Program.name prefix before the size suffix.
+AUDITED_FAMILIES = frozenset({
+    "gemm", "syrk", "syrk-tri", "trmm", "trisolv", "covariance",
+    "adi", "fdtd2d",
+})
+
+
+def audited_family(name: str) -> bool:
+    """True when a Program.name belongs to an audited family (the name
+    is the family followed by a size suffix, e.g. 'syrk-tri-24x24')."""
+    for fam in AUDITED_FAMILIES:
+        if name == fam or (
+            name.startswith(fam + "-")
+            and name[len(fam) + 1: len(fam) + 2].isdigit()
+        ):
+            return True
+    return False
+
+
+def warn_if_unaudited(program: Program) -> None:
+    """Exact-router guard (ADVICE round 5, medium): emit a telemetry
+    event + one-line stderr warning (once per family per process) when
+    the analytic route serves a model family outside the audited
+    allowlist, instead of silently claiming bit-exactness."""
+    if audited_family(program.name):
+        return
+    import re
+
+    family = re.split(r"-\d", program.name)[0]
+    telemetry.warn_once(
+        ("analytic_unaudited", family),
+        f"exact router: model {program.name!r} is outside the audited "
+        "analytic-engine allowlist (tests/test_analytic.py); exactness "
+        "is probe-backed, not proven — run tools/verify_analytic.py "
+        "once for this (program, machine) to remove the assumption",
+        kind="analytic_unaudited", model=program.name,
+    )
 
 
 def _analytic_default_batch() -> int:
@@ -215,25 +260,38 @@ def _classify_keys(nt, kernel, ref_idx, keys, highs, batch, sharding=None):
     outs_p, outs_f = [], []
     n = len(keys)
     n_dev = 1 if sharding is None else sharding.mesh.devices.size
-    for s0 in range(0, n, batch):
-        n_valid = min(batch, n - s0)
-        if _take_eager_path(kernel, n_valid, sharding):
-            # no padding either: shapes are free without a compile
-            with jax.disable_jit():
-                p, f = kernel(keys[s0 : s0 + n_valid], ph, nt.vals, rxv)
-            outs_p.append(np.asarray(p))
-            outs_f.append(np.asarray(f))
-            continue
-        blen = _bucket_len(n_valid, batch)
-        if blen % n_dev:  # each device must own an equal key slice
-            blen += n_dev - blen % n_dev
-        chunk = np.full(blen, keys[0], dtype=np.int64)
-        chunk[:n_valid] = keys[s0 : s0 + n_valid]
-        if sharding is not None:
-            chunk = jax.device_put(chunk, sharding)
-        p, f = kernel(chunk, ph, nt.vals, rxv)
-        outs_p.append(np.asarray(p)[:n_valid])
-        outs_f.append(np.asarray(f)[:n_valid])
+    with telemetry.span("classify", keys=n):
+        for s0 in range(0, n, batch):
+            n_valid = min(batch, n - s0)
+            telemetry.count("dispatches")
+            if _take_eager_path(kernel, n_valid, sharding):
+                # no padding either: shapes are free without a compile
+                telemetry.count("eager_dispatches")
+                with jax.disable_jit():
+                    p, f = kernel(
+                        keys[s0 : s0 + n_valid], ph, nt.vals, rxv
+                    )
+                outs_p.append(np.asarray(p))
+                outs_f.append(np.asarray(f))
+                continue
+            blen = _bucket_len(n_valid, batch)
+            if blen % n_dev:  # each device must own an equal key slice
+                blen += n_dev - blen % n_dev
+            chunk = np.full(blen, keys[0], dtype=np.int64)
+            chunk[:n_valid] = keys[s0 : s0 + n_valid]
+            if sharding is not None:
+                with telemetry.span("shard_put", keys=blen):
+                    chunk = jax.device_put(chunk, sharding)
+            p, f = kernel(chunk, ph, nt.vals, rxv)
+            with telemetry.span("fetch"):
+                p = np.asarray(p)[:n_valid]
+                f = np.asarray(f)[:n_valid]
+                telemetry.count(
+                    "bytes_fetched_to_host", p.nbytes + f.nbytes
+                )
+                telemetry.count("fetches")
+            outs_p.append(p)
+            outs_f.append(f)
     return np.concatenate(outs_p), np.concatenate(outs_f)
 
 
@@ -409,8 +467,12 @@ def _finish_period_ref(nt, kernel, ref_idx, n0, plan, row_memo, batch,
             probe_rows = sorted(
                 members[p] for p in _probe_positions(len(members), rng)
             )
-        eval_rows(probe_rows)
-        model = _fit_affine(probe_rows, [row_dict(r) for r in probe_rows])
+        with telemetry.span("probe_verify", level="row",
+                            probes=len(probe_rows)):
+            eval_rows(probe_rows)
+            model = _fit_affine(
+                probe_rows, [row_dict(r) for r in probe_rows]
+            )
         if model is None:
             mid = len(members) // 2
             fit_rows(members[:mid])
@@ -488,6 +550,15 @@ def _eval_periods_block(nt, kernel, ref_idx, n0s, batch, sharding=None):
     chunked mega-dispatch, killing the per-call overhead that
     dominated period-by-period evaluation (measured ~3 ms/dispatch
     against ~10k-point row sets at syrk-tri N=1536)."""
+    with telemetry.span("period_block", ref=int(ref_idx),
+                        periods=len(n0s)):
+        return _eval_periods_block_inner(
+            nt, kernel, ref_idx, n0s, batch, sharding
+        )
+
+
+def _eval_periods_block_inner(nt, kernel, ref_idx, n0s, batch,
+                              sharding=None):
     plans = {}
     segs = []  # (n0, row | "full", start, length)
     parts = []
@@ -714,12 +785,15 @@ def run_analytic(
     per_tid = [0] * P
     for tid in range(P):
         per_tid[tid] = sum(nt.tid_length(tid) for nt in trace.nests)
+    engine_span = telemetry.span("engine", engine="analytic")
+    engine_span.__enter__()
     for k, nt in enumerate(trace.nests):
         if sum(nt.tid_length(t) for t in range(P)) <= host_cutoff:
             from ..oracle.numpy_ref import fold_nest_numpy
 
-            for tid in range(P):
-                fold_nest_numpy(nt, tid, state)
+            with telemetry.span("fold", nest=k, route="host_lexsort"):
+                for tid in range(P):
+                    fold_nest_numpy(nt, tid, state)
             continue
         nest_kernels = [
             (ri, _kernels_for(nt, ri)["raw"])
@@ -832,8 +906,12 @@ def run_analytic(
                 int(members[p])
                 for p in _probe_positions(len(members), rng)
             )
-            peval_block(probe_ns)
-            model = _fit_affine(probe_ns, [peval(n) for n in probe_ns])
+            with telemetry.span("probe_verify", level="v0",
+                                probes=len(probe_ns)):
+                peval_block(probe_ns)
+                model = _fit_affine(
+                    probe_ns, [peval(n) for n in probe_ns]
+                )
             if model is None:
                 mid = len(members) // 2
                 fit_or_split(members[:mid])
@@ -878,10 +956,12 @@ def run_analytic(
             if len(members):
                 fit_or_split(members)
         peval_block(direct)
-        for n in direct:
-            ev = peval(int(n))
-            for (ri, kk), cc in ev.items():
-                _fold(state, int(tid_of[n]), kk, float(cc))
+        with telemetry.span("fold", nest=k, route="direct"):
+            for n in direct:
+                ev = peval(int(n))
+                for (ri, kk), cc in ev.items():
+                    _fold(state, int(tid_of[n]), kk, float(cc))
+    engine_span.__exit__(None, None, None)
     return OracleResult(
         state=state,
         total_accesses=sum(per_tid),

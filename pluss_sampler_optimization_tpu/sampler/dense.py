@@ -36,6 +36,7 @@ from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
 from ..ops.histogram import N_EXP_BINS, exp_bin, sorted_k_unique
 from ..oracle.serial import OracleResult
+from ..runtime import telemetry
 from ..runtime.hist import PRIState
 
 _REF_BITS = 5  # up to 32 refs per nest
@@ -298,8 +299,14 @@ def _run_outputs(program: Program, machine: MachineConfig, max_share: int,
     trace, run = _compiled_program(program, machine, max_share)
     tids = jnp.arange(machine.thread_num)
     if tid_sharding is not None:
-        tids = jax.device_put(tids, tid_sharding)
-    return trace, jax.device_get(run(tids, jnp.int64(0)))
+        with telemetry.span("shard_put", engine="dense"):
+            tids = jax.device_put(tids, tid_sharding)
+    with telemetry.span("dispatch", engine="dense"):
+        telemetry.count("dispatches")
+        out = run(tids, jnp.int64(0))
+    with telemetry.span("fetch", engine="dense"):
+        out = telemetry.record_fetch(jax.device_get(out))
+    return trace, out
 
 
 def dense_nest_outputs(program: Program, machine: MachineConfig,
@@ -379,7 +386,15 @@ def run_dense(program: Program, machine: MachineConfig,
             from .stream import run_stream
 
             return run_stream(program, machine, max_share=max_share)
-    trace, outs = _run_outputs(program, machine, max_share, tid_sharding)
+    with telemetry.span("engine", engine="dense"):
+        trace, outs = _run_outputs(
+            program, machine, max_share, tid_sharding
+        )
+        with telemetry.span("merge", engine="dense"):
+            return _fold_dense_outputs(machine, outs)
+
+
+def _fold_dense_outputs(machine: MachineConfig, outs) -> OracleResult:
     P = machine.thread_num
     state = PRIState(P)
     per_tid = [0] * P

@@ -44,6 +44,7 @@ from ..config import MachineConfig, SamplerConfig
 from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
 from ..ops.histogram import fixed_k_unique, merge_pair_sets
+from ..runtime import telemetry
 from ..runtime.hist import PRIState
 from .nextuse import INF
 
@@ -694,6 +695,11 @@ def warmup(
     cfg = cfg or SamplerConfig()
     if batch is None:
         batch = default_batch()
+    with telemetry.span("warmup", engine="sampled"):
+        _warmup_kernels(program, machine, cfg, batch, capacity)
+
+
+def _warmup_kernels(program, machine, cfg, batch, capacity) -> None:
     trace, kernels = _program_kernels(program, machine)
     drawn_buckets: set = set()
     for k, ri, kernel, kernel_s in kernels:
@@ -863,6 +869,8 @@ def sampled_outputs(
             if prior is not None:
                 results.append(prior)
                 continue
+        ref_span = telemetry.span("ref", engine="sampled", ref=name)
+        ref_span.__enter__()
         # Device path first: draw + dedup + thin on the device, then
         # ONE scan-fused dispatch over the whole buffer with on-device
         # chunk merging (sampler/draw.py + _build_ref_kernel_scan —
@@ -875,14 +883,17 @@ def sampled_outputs(
         if _use_device_draw(cfg):
             from .draw import draw_sample_keys_device
 
-            drawn = draw_sample_keys_device(
-                nt, ri, cfg, seed=cfg.seed * 1000003 + idx, batch=batch
-            )
+            with telemetry.span("draw", where="device"):
+                drawn = draw_sample_keys_device(
+                    nt, ri, cfg, seed=cfg.seed * 1000003 + idx,
+                    batch=batch,
+                )
         if drawn is None:
             # device drawing disabled, over the device budget, or s==0
-            keys_all, highs = draw_sample_keys(
-                nt, ri, cfg, seed=cfg.seed * 1000003 + idx
-            )
+            with telemetry.span("draw", where="host"):
+                keys_all, highs = draw_sample_keys(
+                    nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+                )
             n_samples = len(keys_all)
         else:
             dev_keys, dev_mask, n_samples, highs = drawn
@@ -895,17 +906,23 @@ def sampled_outputs(
         def drain(entry):
             nonlocal cold, cap
             out, redo, dispatch_cap = entry
-            keys, counts, n_unique, c = jax.device_get(out)
+            with telemetry.span("fetch"):
+                keys, counts, n_unique, c = telemetry.record_fetch(
+                    jax.device_get(out)
+                )
             while int(n_unique) > dispatch_cap:
                 # rare: more distinct (reuse, class) pairs than slots —
                 # recompile with a larger capacity rather than abort
                 dispatch_cap = max(dispatch_cap * 4, int(n_unique))
                 cap = max(cap, dispatch_cap)
-                keys, counts, n_unique, c = jax.device_get(
-                    redo(dispatch_cap)
-                )
+                telemetry.count("capacity_regrows")
+                with telemetry.span("fetch", regrow=True):
+                    keys, counts, n_unique, c = telemetry.record_fetch(
+                        jax.device_get(redo(dispatch_cap))
+                    )
             cold += float(c)
-            decode_pairs(keys, counts, noshare, share)
+            with telemetry.span("merge"):
+                decode_pairs(keys, counts, noshare, share)
 
         ph = _pad_highs(highs)
         rxv = np.int64(ri)
@@ -914,9 +931,11 @@ def sampled_outputs(
 
             def redo(c2, dk=dev_keys, dm=dev_mask, nc=n_chunks, ph=ph,
                      nv=nt.vals, rxv=rxv):
+                telemetry.count("dispatches")
                 return kernel_s(dk, dm, ph, nv, rxv, c2, nc)
 
-            pending.append((redo(cap), redo, cap))
+            with telemetry.span("dispatch", form="scan"):
+                pending.append((redo(cap), redo, cap))
         else:
             for s0 in range(0, n_samples, batch):
                 chunk, n_valid = pad_keys(
@@ -927,13 +946,16 @@ def sampled_outputs(
 
                 def redo(c2, chunk=chunk, n_valid=n_valid, ph=ph,
                          nv=nt.vals, rxv=rxv):
+                    telemetry.count("dispatches")
                     return kernel(chunk, n_valid, ph, nv, rxv, c2)
 
-                pending.append((redo(cap), redo, cap))
+                with telemetry.span("dispatch", form="chunk"):
+                    pending.append((redo(cap), redo, cap))
                 if len(pending) >= 4:
                     drain(pending.pop(0))
         for entry in pending:
             drain(entry)
+        ref_span.__exit__(None, None, None)
         result = SampledRefResult(
             name=name, noshare=noshare, share=share, cold=cold,
             n_samples=n_samples,
@@ -1026,5 +1048,8 @@ def run_sampled(
 ) -> tuple[PRIState, list[SampledRefResult]]:
     """Sampled engine -> PRIState (see fold_results for the v1 form)."""
     cfg = cfg or SamplerConfig()
-    results = sampled_outputs(program, machine, cfg, **kw)
-    return fold_results(results, machine.thread_num, v2), results
+    with telemetry.span("engine", engine="sampled"):
+        results = sampled_outputs(program, machine, cfg, **kw)
+        with telemetry.span("merge", stage="fold_results"):
+            state = fold_results(results, machine.thread_num, v2)
+    return state, results

@@ -38,6 +38,7 @@ from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
 from ..ops.histogram import N_EXP_BINS, exp_bin, sorted_k_unique
 from ..oracle.serial import OracleResult
+from ..runtime import telemetry
 from ..runtime.hist import PRIState
 from .dense import _REF_BITS, _ceil_log2, nest_geometry, packed_ref_keys
 
@@ -192,11 +193,17 @@ def run_stream(
     P = machine.thread_num
     state = PRIState(P)
     per_tid = [0] * P
-    for run_tid, fresh_carry, _ in kernels:
+    engine_span = telemetry.span("engine", engine="stream")
+    engine_span.__enter__()
+    for nest_k, (run_tid, fresh_carry, _) in enumerate(kernels):
         for tid in range(P):
-            nosh, ys, cold, n_acc = jax.device_get(
-                run_tid(jnp.int64(tid), fresh_carry())
-            )
+            with telemetry.span("scan", nest=nest_k, tid=tid):
+                telemetry.count("dispatches")
+                out = run_tid(jnp.int64(tid), fresh_carry())
+                with telemetry.span("fetch"):
+                    nosh, ys, cold, n_acc = telemetry.record_fetch(
+                        jax.device_get(out)
+                    )
             sk, sc, nu = ys
             if int(nu.max(initial=0)) > sk.shape[1]:
                 raise RuntimeError(
@@ -217,6 +224,7 @@ def run_stream(
                         hs = state.share[tid].setdefault(ratio, {})
                         hs[reuse] = hs.get(reuse, 0.0) + float(cnt)
             per_tid[tid] += int(n_acc)
+    engine_span.__exit__(None, None, None)
     return OracleResult(
         state=state, total_accesses=sum(per_tid), per_tid_accesses=per_tid
     )

@@ -203,12 +203,28 @@ def test_bench_emits_json_line(tmp_path):
              "--exact-model", "syrk", "--exact-n", "64"],
             capture_output=True, text=True, timeout=900, cwd=REPO,
         )
-    # the stamped sidecar (+ refreshed latest pointer) lands next to
-    # bench.py; drop what this test created so repeat runs stay clean
-    for name in set(os.listdir(REPO)) - before:
-        if name.startswith("BENCH_EVIDENCE"):
-            os.remove(os.path.join(REPO, name))
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    # the stamped sidecars (evidence + telemetry, + refreshed latest
+    # pointer) land next to bench.py; drop what this test created so
+    # repeat runs stay clean — but first pin the telemetry sidecar's
+    # contract: it exists and validates against the documented schema
+    created = set(os.listdir(REPO)) - before
+    tele_files = [n for n in created if n.startswith("BENCH_TELEMETRY")]
+    try:
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert len(tele_files) == 1, created
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_telemetry_schema
+        finally:
+            sys.path.pop(0)
+        with open(os.path.join(REPO, tele_files[0])) as f:
+            tele_doc = json.load(f)
+        assert check_telemetry_schema.validate(tele_doc) == []
+        assert tele_doc["counters"].get("dispatches", 0) > 0
+    finally:
+        for name in created:
+            if name.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY")):
+                os.remove(os.path.join(REPO, name))
     json_lines = [
         l for l in proc.stdout.splitlines() if l.startswith("{")
     ]
@@ -224,6 +240,8 @@ def test_bench_emits_json_line(tmp_path):
     # the analytic secondary row reaches the tail with its engine label
     assert final["exact_secondary"]["engine"] == "analytic"
     doc = json.loads(json_lines[0])  # the full record
+    # evidence names its telemetry sidecar so the two cross-reference
+    assert doc["extra"]["telemetry"].startswith("BENCH_TELEMETRY_")
     assert doc["extra"]["analytic_exact"]["engine"] == "analytic"
     assert doc["extra"]["analytic_exact"]["mrc_l1_err"] == 0.0
     assert doc["unit"] == "samples/s/chip"

@@ -28,8 +28,22 @@ def test_timer_and_flush():
 
 
 def test_timed_reps():
-    times, result = timed(lambda: 42, reps=3, flush=False)
+    times, result, flushes = timed(lambda: 42, reps=3, flush=False)
     assert len(times) == 3 and result == 42
+    assert flushes == [0.0, 0.0, 0.0]  # flush disabled: no cost
+
+
+def test_timed_flush_cost_separate_from_reps():
+    """The satellite contract: the cache flush is timed outside the
+    measured region and returned per rep — a slow flush can never leak
+    into the reported rep seconds."""
+    times, result, flushes = timed(lambda: "x", reps=2, flush=True,
+                                   flush_kb=256)
+    assert result == "x" and len(flushes) == 2
+    assert all(f > 0.0 for f in flushes)
+    # the measured region is a constant-return lambda: even on a slow
+    # host it is orders of magnitude below the 256 KB flush walk
+    assert all(t < f for t, f in zip(times, flushes))
 
 
 def test_access_trace_order_and_refs():

@@ -1,9 +1,15 @@
 """Micro-profile the sampled engine's per-batch stages on the live device.
 
-Splits one ref's dispatch into its three stages — key decode, classify
-(closed-form next-use), and the fixed_k_unique reduction — and times
-each at the default accelerator batch size, so "the engine is slow on
-X" resolves to the stage that actually is. Run on the bench host:
+Splits one ref's dispatch into its stages — key decode, geometry,
+next-use solve, classify, the fixed_k_unique reduction, the device
+draw, and the scan-fused whole-buffer kernel — and times each at the
+default accelerator batch size, so "the engine is slow on X" resolves
+to the stage that actually is. Built on the shared telemetry layer
+(runtime/telemetry.py): every stage rep is a device-synced span
+(`Span.block` under `enable(device_sync=True)`), the printed medians
+are read back off the recorded span tree, and `--telemetry-out`
+exports the whole run in the standard schema for offline diffing.
+Run on the bench host:
 
     JAX_PLATFORMS=tpu python tools/profile_tpu_stages.py [--n 512]
 """
@@ -11,21 +17,13 @@ X" resolves to the stage that actually is. Run on the bench host:
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
-import numpy as np
-
-
-def med_time(fn, *args, reps=5):
-    import jax
-
-    jax.block_until_ready(fn(*args))  # compile
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    return sorted(ts)[len(ts) // 2]
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def main() -> int:
@@ -33,7 +31,13 @@ def main() -> int:
     ap.add_argument("--n", type=int, default=512)
     ap.add_argument("--model", default="gemm")
     ap.add_argument("--ref", type=int, default=0)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--telemetry-out", default=None, metavar="PATH",
+                    help="also write the run's full telemetry JSON "
+                    "(schema: README \"Observability\")")
     args = ap.parse_args()
+
+    import numpy as np
 
     import jax
     import jax.numpy as jnp
@@ -46,6 +50,7 @@ def main() -> int:
     from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
     from pluss_sampler_optimization_tpu.models import REGISTRY
     from pluss_sampler_optimization_tpu.ops.histogram import fixed_k_unique
+    from pluss_sampler_optimization_tpu.runtime import telemetry
     from pluss_sampler_optimization_tpu.sampler.sampled import (
         _best_sink,
         _sample_geometry,
@@ -54,6 +59,26 @@ def main() -> int:
         decode_sample_keys,
         default_batch,
     )
+
+    # device_sync=True: each stage span's .block() records the
+    # span-start -> block_until_ready latency as sync_s — the
+    # device-complete time, which is what a stage profile must report
+    # (wall alone would time only the async dispatch)
+    tele = telemetry.enable(device_sync=True)
+
+    def med_time(name, fn, *fn_args, reps=args.reps):
+        """Median device-synced seconds of `reps` span-wrapped calls
+        (one warm call first so compile time stays out of the reps —
+        it still lands in the telemetry compile counters)."""
+        jax.block_until_ready(fn(*fn_args))
+        for _ in range(reps):
+            with telemetry.span(name, stage=True) as sp:
+                sp.block(fn(*fn_args))
+        ts = sorted(
+            s.sync_s for s in tele.find_spans(name)
+            if s.sync_s is not None
+        )[-reps:]
+        return ts[len(ts) // 2]
 
     machine = MachineConfig()
     prog = REGISTRY[args.model](args.n)
@@ -68,23 +93,23 @@ def main() -> int:
     print(f"batch={batch} highs={highs}")
 
     dec = jax.jit(lambda k: decode_sample_keys(k, tuple(highs)))
-    t = med_time(dec, keys)
+    t = med_time("decode", dec, keys)
     print(f"decode:          {t * 1e3:9.2f} ms")
 
     samples = dec(keys)
 
     geo = jax.jit(lambda s: _sample_geometry(nt, args.ref, s))
-    t = med_time(geo, samples)
+    t = med_time("geometry", geo, samples)
     print(f"geometry:        {t * 1e3:9.2f} ms")
 
     tid, p0, line, m0 = geo(samples)
 
     sink = jax.jit(lambda a, b, c, d: _best_sink(nt, args.ref, a, b, c, d))
-    t = med_time(sink, tid, p0, line, m0)
+    t = med_time("best_sink", sink, tid, p0, line, m0)
     print(f"best_sink:       {t * 1e3:9.2f} ms")
 
     cls = jax.jit(lambda s: classify_samples(nt, args.ref, s))
-    t = med_time(cls, samples)
+    t = med_time("classify", cls, samples)
     print(f"classify (all):  {t * 1e3:9.2f} ms")
 
     packed, _, _, found = cls(samples)
@@ -93,7 +118,7 @@ def main() -> int:
     uniq = jax.jit(
         lambda v, m: fixed_k_unique(v, m, 64), static_argnums=()
     )
-    t = med_time(uniq, packed, found & w)
+    t = med_time("fixed_k_unique", uniq, packed, found & w)
     print(f"fixed_k_unique:  {t * 1e3:9.2f} ms")
 
     # The redesigned engine's stages: on-device draw (threefry +
@@ -105,6 +130,7 @@ def main() -> int:
     )
     from pluss_sampler_optimization_tpu.sampler.sampled import (
         _build_ref_kernel_scan,
+        _pad_highs,
     )
 
     cfg_draw = SamplerConfig(ratio=0.1, seed=0, device_draw=True)
@@ -113,33 +139,44 @@ def main() -> int:
     t_cold = time.perf_counter() - t0
     if drawn is None:
         print("device draw:     declined (over budget / empty space)")
+        _finish(tele, args)
         return 0
     dk, dm, s, dhighs = drawn
-    ts = []
-    for r in range(1, 4):
-        t0 = time.perf_counter()
-        jax.block_until_ready(
-            draw_sample_keys_device(nt, args.ref, cfg_draw, r, batch)[0]
-        )
-        ts.append(time.perf_counter() - t0)
-    print(f"device draw:     {sorted(ts)[1] * 1e3:9.2f} ms  "
+    for r in range(1, args.reps + 1):
+        with telemetry.span("device_draw", stage=True) as sp:
+            sp.block(draw_sample_keys_device(
+                nt, args.ref, cfg_draw, r, batch
+            )[0])
+    ts = sorted(
+        sp.sync_s for sp in tele.find_spans("device_draw")
+        if sp.sync_s is not None
+    )
+    print(f"device draw:     {ts[len(ts) // 2] * 1e3:9.2f} ms  "
           f"(cold {t_cold:.1f} s; B={dk.shape[0]}, s={s})")
-
-    from pluss_sampler_optimization_tpu.sampler.sampled import _pad_highs
 
     kscan = _build_ref_kernel_scan(nt, args.ref)
     nc = dk.shape[0] // batch
     t = med_time(
+        "scan_kernel",
         lambda: kscan(
             dk, dm, _pad_highs(dhighs), nt.vals, np.int64(args.ref), 64, nc
         ),
-        reps=3,
+        reps=min(3, args.reps),
     )
     print(f"scan kernel:     {t * 1e3:9.2f} ms  (n_chunks={nc})")
+    _finish(tele, args)
     return 0
 
 
-if __name__ == "__main__":
-    import sys
+def _finish(tele, args) -> None:
+    from pluss_sampler_optimization_tpu.runtime import telemetry
 
+    telemetry.disable()
+    tele.print_summary()
+    if args.telemetry_out:
+        tele.write_json(args.telemetry_out)
+        print(f"telemetry JSON -> {args.telemetry_out}")
+
+
+if __name__ == "__main__":
     sys.exit(main())
