@@ -277,9 +277,13 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
     stamped = _stamped_sidecar_name(str(headline.get("metric", "run")))
     evidence_ref = stamped
     try:
-        with open(os.path.join(sidecar_dir, stamped), "w") as f:
-            json.dump(full, f, indent=1)
-            f.write("\n")
+        # atomic (tmp+rename): a killed bench never leaves a truncated
+        # evidence file for the driver's collectors to choke on
+        from pluss_sampler_optimization_tpu.runtime.io import (
+            atomic_write_json,
+        )
+
+        atomic_write_json(os.path.join(sidecar_dir, stamped), full)
     except OSError:
         evidence_ref = "stdout line above (sidecar write failed)"
     else:
@@ -294,9 +298,8 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
             os.symlink(stamped, latest)
         except OSError:
             try:
-                with open(latest, "w") as f:
-                    json.dump({"latest": stamped}, f)
-                    f.write("\n")
+                atomic_write_json(latest, {"latest": stamped},
+                                  indent=None)
             except OSError:
                 pass
 
@@ -982,6 +985,64 @@ def main() -> int:
             extra["second_model"] = sm
         except Exception as e:  # the headline metric must still print
             extra["second_model_error"] = repr(e)
+
+    # Request-serving latency: the analysis service's cold-vs-warm
+    # story measured on this host — one small exact request cold (the
+    # engine executes and the result lands in a content-addressed
+    # store), then warm from the same service (memory tier), then warm
+    # from a FRESH service instance (disk tier). The warm/cold ratio
+    # is the driver-visible evidence for `--cache-dir` serving
+    # (README "Serving"); warm repeats perform zero engine work.
+    if extras_budget_left("service_cache", extra):
+        sc: dict = {}
+        extra["service_cache"] = sc
+        try:
+            import shutil
+            import tempfile
+
+            from pluss_sampler_optimization_tpu.service import (
+                AnalysisRequest,
+                AnalysisService,
+            )
+
+            svc_dir = tempfile.mkdtemp(prefix="bench_service_cache_")
+            try:
+                req = AnalysisRequest(
+                    model=args.model, n=min(args.n, 128),
+                    engine="exact",
+                )
+                with AnalysisService(cache_dir=svc_dir) as svc:
+                    t0 = time.perf_counter()
+                    cold = svc.analyze(req)
+                    cold_s = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    warm = svc.analyze(req)
+                    warm_s = time.perf_counter() - t0
+                with AnalysisService(cache_dir=svc_dir) as svc2:
+                    t0 = time.perf_counter()
+                    disk = svc2.analyze(req)
+                    disk_s = time.perf_counter() - t0
+                sc.update({
+                    "model": req.model,
+                    "n": req.n,
+                    "engine_used": cold.engine_used,
+                    "cold_s": round(cold_s, 4),
+                    "warm_mem_s": round(warm_s, 6),
+                    "warm_disk_s": round(disk_s, 6),
+                    # tier labels double as correctness evidence: the
+                    # run is useless if the "warm" requests missed
+                    "cold_cache": cold.cache,
+                    "warm_mem_cache": warm.cache,
+                    "warm_disk_cache": disk.cache,
+                    "warm_speedup": (
+                        round(cold_s / warm_s, 1) if warm_s > 0
+                        else None
+                    ),
+                })
+            finally:
+                shutil.rmtree(svc_dir, ignore_errors=True)
+        except Exception as e:  # never sink the headline metric
+            sc["error"] = repr(e)
 
     if have_counters and "compile_cache" in extra:
         # final snapshot: the extras (periodic_exact, second model) may

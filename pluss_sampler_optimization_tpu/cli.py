@@ -26,20 +26,46 @@ import sys
 
 
 def _build_model(name: str, n: int, tsteps: int):
-    import inspect
+    from .models import build
 
-    from .models import REGISTRY
+    try:
+        return build(name, n, tsteps)
+    except (KeyError, ValueError) as e:
+        raise SystemExit(str(e.args[0] if e.args else e))
 
-    if name not in REGISTRY:
-        raise SystemExit(
-            f"unknown model {name!r} (have {', '.join(sorted(REGISTRY))})"
-        )
-    fn = REGISTRY[name]
-    if "tsteps" in inspect.signature(fn).parameters:
-        return fn(n, tsteps=tsteps)
-    if tsteps != 1:
-        raise SystemExit(f"model {name!r} has no time-step dimension")
-    return fn(n)
+
+def _list_models() -> int:
+    """The 18-model registry with family/engine-audit status: which
+    exact-router families are PROVEN bit-identical through the
+    analytic route (sampler/analytic.py::AUDITED_FAMILIES) and which
+    inherit the probe-backed ledger."""
+    from .models import REGISTRY, build
+    from .sampler.analytic import audited_family
+
+    rows = []
+    for name in sorted(REGISTRY):
+        prog = build(name, 8)
+        rows.append((
+            name,
+            len(prog.nests),
+            sum(len(nest.refs) for nest in prog.nests),
+            max(nest.depth for nest in prog.nests),
+            any(nest.is_triangular for nest in prog.nests),
+            audited_family(prog.name),
+        ))
+    print(f"{'model':<12} {'nests':>5} {'refs':>4} {'depth':>5} "
+          f"{'triangular':>10} {'analytic-audit':>14}")
+    for name, nests, refs, depth, tri, audited in rows:
+        print(f"{name:<12} {nests:>5} {refs:>4} {depth:>5} "
+              f"{'yes' if tri else 'no':>10} "
+              f"{'audited' if audited else 'probe-backed':>14}")
+    print(
+        f"{len(rows)} models; 'audited' = exact-router analytic "
+        "exactness proven by tests/test_analytic.py or recorded "
+        "tools/verify_analytic.py audits (README \"Exactness "
+        "coverage\")"
+    )
+    return 0
 
 
 def _run_engine(engine: str, program, machine, args):
@@ -140,7 +166,12 @@ def _run_engine(engine: str, program, machine, args):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
-    ap.add_argument("mode", choices=["acc", "speed", "sample", "trace"])
+    ap.add_argument("mode", nargs="?",
+                    choices=["acc", "speed", "sample", "trace", "serve"])
+    ap.add_argument("--list-models", action="store_true",
+                    help="print the model registry (nest/ref geometry "
+                    "+ exact-router analytic audit status, from "
+                    "sampler/analytic.py::AUDITED_FAMILIES) and exit")
     ap.add_argument("--model", default="gemm",
                     help="gemm | 2mm | 3mm | syrk | jacobi-2d | mvt | bicg "
                     "| gesummv | atax | gemver | doitgen | fdtd-2d | heat-3d"
@@ -255,12 +286,62 @@ def main(argv=None) -> int:
         "Perfetto/XLA trace there (open at ui.perfetto.dev or via "
         "TensorBoard). Independent of --telemetry-out.",
     )
+    ap.add_argument(
+        "--cache-dir",
+        default=None,
+        metavar="DIR",
+        help="serve results through the analysis service's "
+        "content-addressed store rooted at DIR (serve mode, and "
+        "acc/speed/sample for the plain request pipeline): a repeated "
+        "request returns the stored bit-identical result with zero "
+        "engine work. See README \"Serving\".",
+    )
+    ap.add_argument(
+        "--deadline-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="per-request deadline for service-routed runs "
+        "(--cache-dir / serve mode): an engine overrunning it "
+        "degrades down the chain (exact -> sampled, ...), recorded "
+        "in the response and as a telemetry event",
+    )
+    ap.add_argument(
+        "--requests",
+        default="-",
+        metavar="PATH",
+        help="serve mode: JSONL request batch to process ('-' = "
+        "stdin; one JSON request object per line, README \"Serving\")",
+    )
+    ap.add_argument(
+        "--responses",
+        default="-",
+        metavar="PATH",
+        help="serve mode: where to write the JSONL responses "
+        "('-' = stdout)",
+    )
+    ap.add_argument(
+        "--max-workers",
+        type=int,
+        default=4,
+        metavar="N",
+        help="serve mode: concurrent request executions (bounded "
+        "pool; identical in-flight requests coalesce regardless)",
+    )
     args = ap.parse_args(argv)
+
+    if args.list_models:
+        return _list_models()
+    if args.mode is None:
+        ap.error("mode is required (acc|speed|sample|trace|serve)")
 
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.mode == "serve":
+        return _observed(args, lambda: _serve(args))
 
     from .config import MachineConfig
 
@@ -305,6 +386,49 @@ def main(argv=None) -> int:
                 f"(have {', '.join(_ENGINES)})"
             )
 
+    if args.cache_dir:
+        if args.mode == "trace":
+            raise SystemExit(
+                "--cache-dir serves analysis results (acc|speed|"
+                "sample|serve); trace mode has none"
+            )
+        from .service.executor import SERVICE_ENGINES
+
+        if engine not in SERVICE_ENGINES:
+            raise SystemExit(
+                f"--cache-dir serves the request pipeline engines "
+                f"({', '.join(SERVICE_ENGINES)}); {engine!r} is not "
+                "one of them"
+            )
+        blocked = [
+            flag for flag, on in (
+                ("--r10", args.r10),
+                ("--diff-against", args.diff_against),
+                ("--checkpoint-dir", args.checkpoint_dir),
+                ("--shard", args.shard),
+                ("--pallas-hist", args.pallas_hist),
+            ) if on
+        ]
+        if blocked:
+            raise SystemExit(
+                f"--cache-dir serves the plain request pipeline; it "
+                f"does not compose with {', '.join(blocked)}"
+            )
+    elif args.deadline_s is not None:
+        raise SystemExit(
+            "--deadline-s bounds service-routed requests; it needs "
+            "--cache-dir (or serve mode, where each request line "
+            "carries its own deadline_s)"
+        )
+
+    return _observed(
+        args, lambda: _execute(args, machine, program, engine)
+    )
+
+
+def _observed(args, fn) -> int:
+    """Run fn() under the observability flags (--telemetry-out /
+    --profile-dir) — shared by the mode executor and serve mode."""
     tele = None
     if args.telemetry_out:
         from .runtime import telemetry
@@ -315,8 +439,8 @@ def main(argv=None) -> int:
             import jax
 
             with jax.profiler.trace(args.profile_dir):
-                return _execute(args, machine, program, engine)
-        return _execute(args, machine, program, engine)
+                return fn()
+        return fn()
     finally:
         if tele is not None:
             from .runtime import telemetry
@@ -326,12 +450,97 @@ def main(argv=None) -> int:
             tele.write_json(args.telemetry_out)
 
 
+def _request_from_args(args, engine):
+    from .service import AnalysisRequest
+
+    return AnalysisRequest(
+        model=args.model, n=args.n, tsteps=args.tsteps, engine=engine,
+        runtime=args.runtime, threads=args.threads, chunk=args.chunk,
+        ratio=args.ratio, seed=args.seed, device_draw=args.device_draw,
+        deadline_s=args.deadline_s,
+    )
+
+
+def _serve(args) -> int:
+    """`serve` mode: process a JSONL request batch end to end."""
+    from .service import AnalysisService, serve_jsonl
+
+    fin = sys.stdin if args.requests == "-" else open(args.requests)
+    fout = (
+        sys.stdout if args.responses == "-"
+        else open(args.responses, "w")
+    )
+    try:
+        with AnalysisService(
+            cache_dir=args.cache_dir, max_workers=args.max_workers
+        ) as svc:
+            failures = serve_jsonl(svc, fin, fout)
+    finally:
+        if fin is not sys.stdin:
+            fin.close()
+        if fout is not sys.stdout:
+            fout.close()
+    if failures:
+        print(f"serve: {failures} request(s) failed (per-line "
+              "status is in the responses)", file=sys.stderr)
+    return 0
+
+
+def _execute_via_service(args, machine, program, engine) -> int:
+    """acc/speed/sample through the analysis service (--cache-dir):
+    identical dumps to the direct path, served from the
+    content-addressed store when warm."""
+    import time
+
+    from .runtime import report
+    from .service import AnalysisService
+
+    request = _request_from_args(args, engine)
+    with AnalysisService(cache_dir=args.cache_dir) as svc:
+        if args.mode == "speed":
+            times = []
+            for rep in range(args.reps):
+                t0 = time.perf_counter()
+                resp = svc.analyze(request)
+                dt = time.perf_counter() - t0
+                if not resp.ok:
+                    raise SystemExit(
+                        f"service request failed: {resp.error}"
+                    )
+                times.append(dt)
+                print(f"{engine} {program.name} run {rep}: "
+                      f"{dt:.6f} s (cache {resp.cache})")
+            print(
+                f"{engine} {program.name}: best {min(times):.6f} s, "
+                f"mean {sum(times) / len(times):.6f} s over "
+                f"{len(times)} runs"
+            )
+            return 0
+        resp = svc.analyze(request)
+        if not resp.ok:
+            raise SystemExit(f"service request failed: {resp.error}")
+        if resp.degraded:
+            print(f"service degraded: {resp.degraded}",
+                  file=sys.stderr)
+        lines = []
+        if args.mode == "sample" and resp.per_ref_lines:
+            lines += resp.per_ref_lines
+        lines += resp.dump_lines
+        report.emit(lines)
+        if args.mrc_out:
+            report.write_mrc_to_file(resp.mrc, args.mrc_out)
+    return 0
+
+
 def _execute(args, machine, program, engine) -> int:
     """Run the selected mode (spans/counters land in the active
     telemetry run, if any — main() owns enable/export)."""
     from .runtime import report
     from .runtime.aet import aet_mrc
     from .runtime.cri import cri_distribute
+
+    if args.cache_dir and args.mode in ("acc", "speed", "sample"):
+        return _execute_via_service(args, machine, program, engine)
 
     if args.mode == "trace":
         # the reference's -DDEBUG access/reuse logs (runtime/debug.py)

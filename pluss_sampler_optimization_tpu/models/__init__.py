@@ -24,6 +24,24 @@ from .syrk_tri import syrk_tri
 from .trisolv import trisolv
 from .trmm import trmm
 
+def build(name: str, n: int, tsteps: int = 1):
+    """Build a registry model at size n (shared by cli.py and the
+    analysis service). Raises KeyError for an unknown model and
+    ValueError when tsteps is passed to a model without a time axis."""
+    import inspect
+
+    if name not in REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r} (have {', '.join(sorted(REGISTRY))})"
+        )
+    fn = REGISTRY[name]
+    if "tsteps" in inspect.signature(fn).parameters:
+        return fn(n, tsteps=tsteps)
+    if tsteps != 1:
+        raise ValueError(f"model {name!r} has no time-step dimension")
+    return fn(n)
+
+
 REGISTRY = {
     "gemm": gemm,
     "2mm": mm2,
@@ -49,4 +67,5 @@ __all__ = [
     "gemm", "mm2", "mm3", "syrk_rect", "jacobi2d", "mvt", "bicg",
     "gesummv", "atax", "gemver", "doitgen", "fdtd2d", "heat3d",
     "syrk_tri", "trmm", "trisolv", "covariance", "adi", "REGISTRY",
+    "build",
 ]

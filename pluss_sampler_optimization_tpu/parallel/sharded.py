@@ -213,10 +213,15 @@ def _sharded_kernels_for(
     use_pallas_hist: bool, scan: bool,
 ):
     from ..sampler.sampled import lru_cached
+    from ..service.fingerprint import structure_digest
 
+    # the structural half of the key is the canonical signature digest
+    # (service/fingerprint.py), matching sampler/sampled.py; the mesh
+    # rides alongside raw — its identity is process-local by nature
     return lru_cached(
         _SHARDED_SIG_KERNELS,
-        (_kernel_sig(nt, ref_idx), mesh, capacity, use_pallas_hist, scan),
+        (structure_digest(_kernel_sig(nt, ref_idx)), mesh, capacity,
+         use_pallas_hist, scan),
         lambda: _build_sharded_ref_kernel(
             nt, ref_idx, mesh, capacity, use_pallas_hist, scan
         ),
@@ -224,7 +229,7 @@ def _sharded_kernels_for(
     )
 
 
-@functools.lru_cache(maxsize=16)
+@telemetry.counted_lru_cache(maxsize=16)
 def _sharded_program_kernels(
     program: Program,
     machine: MachineConfig,

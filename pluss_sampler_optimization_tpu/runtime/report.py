@@ -12,7 +12,6 @@ is why the reference sorts into a std::map before printing, :692-698).
 
 from __future__ import annotations
 
-import io
 from typing import Iterable
 
 import numpy as np
@@ -78,10 +77,14 @@ def mrc_lines(mrc: np.ndarray, header: bool = True) -> list[str]:
 
 
 def write_mrc_to_file(mrc: np.ndarray, path: str) -> None:
-    """pluss_write_mrc_to_file (pluss_utils.h:885-913)."""
-    with io.open(path, "w") as f:
-        for line in mrc_lines(mrc):
-            f.write(line + "\n")
+    """pluss_write_mrc_to_file (pluss_utils.h:885-913); written
+    atomically (runtime/io.py) so a killed process never leaves a
+    truncated curve behind."""
+    from .io import atomic_write_text
+
+    atomic_write_text(
+        path, "".join(line + "\n" for line in mrc_lines(mrc))
+    )
 
 
 def emit(lines: Iterable[str]) -> None:

@@ -44,7 +44,6 @@ uninstrumented code because nothing in this module executes.
 from __future__ import annotations
 
 import json
-import os
 import sys
 import threading
 import time
@@ -237,11 +236,9 @@ class Telemetry:
         }
 
     def write_json(self, path: str, speed_probe: bool = False) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.to_json(speed_probe=speed_probe), f, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
+        from .io import atomic_write_json
+
+        atomic_write_json(path, self.to_json(speed_probe=speed_probe))
 
     def summary_lines(self, top: int = 12) -> list[str]:
         """Compact human summary: root spans with their heaviest
@@ -374,6 +371,44 @@ def record_fetch(host_tree):
     tele.count("fetches")
     tele.count("bytes_fetched_to_host", nbytes)
     return host_tree
+
+
+def counted_lru_cache(maxsize: int = 128,
+                      counter: str = "kernel_cache"):
+    """functools.lru_cache with telemetry hit/miss counters.
+
+    Drop-in for the kernel caches scattered across the engines
+    (stream/draw/periodic/dense/sharded program-kernel caches): every
+    lookup lands in `<counter>_hits` / `<counter>_misses` of the
+    active run, so a telemetry export shows compiled-kernel reuse next
+    to the result-cache counters the service records. `cache_clear` /
+    `cache_info` pass through (tests clear these caches directly).
+    The hit/miss attribution reads cache_info around the call — exact
+    single-threaded; under concurrent lookups a race can misattribute
+    a count, never miscompute a result."""
+    import functools
+
+    def deco(fn):
+        cached = functools.lru_cache(maxsize=maxsize)(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if _current is None:
+                return cached(*args, **kwargs)
+            before = cached.cache_info().hits
+            out = cached(*args, **kwargs)
+            if cached.cache_info().hits > before:
+                count(counter + "_hits")
+            else:
+                count(counter + "_misses")
+            return out
+
+        wrapper.cache_clear = cached.cache_clear
+        wrapper.cache_info = cached.cache_info
+        wrapper.__wrapped__ = cached
+        return wrapper
+
+    return deco
 
 
 _warned_once: set = set()

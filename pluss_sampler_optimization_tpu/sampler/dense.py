@@ -25,7 +25,6 @@ padded entries into a dedicated invalid group.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -275,7 +274,7 @@ def _nest_device_arrays(nt: NestTrace, max_share_values: int):
     return per_tid
 
 
-@functools.lru_cache(maxsize=32)
+@telemetry.counted_lru_cache(maxsize=32)
 def _compiled_program(program: Program, machine: MachineConfig, max_share: int):
     trace = ProgramTrace(program, machine)
     fns = [

@@ -41,12 +41,13 @@ so tri refs compile per nest).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
+
+from ..runtime import telemetry
 
 # Above this many int64 buffer slots (~2.2e8 -> ~10 GB across the
 # sort/priority temporaries) the draw falls back to the host path:
@@ -140,7 +141,7 @@ def _select_exact(sk, valid_first, s, pri_key):
     return chosen, U, jnp.sum(chosen.astype(jnp.int64))
 
 
-@functools.lru_cache(maxsize=32)
+@telemetry.counted_lru_cache(maxsize=32)
 def _rect_draw_kernel(B: int):
     """Shared draw kernel for rectangular refs: every ref/model/N with
     the same bucket size reuses one compile (space and s are traced)."""

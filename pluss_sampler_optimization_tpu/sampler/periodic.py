@@ -58,7 +58,6 @@ same mesh-native execution story as the approximate engines.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -77,7 +76,7 @@ from .dense import _REF_BITS, _ceil_log2, nest_geometry, packed_ref_keys
 _TIER_B_MAX_REACH = 8  # periods a tier-B numeric window must cover
 
 
-@functools.lru_cache(maxsize=64)
+@telemetry.counted_lru_cache(maxsize=64)
 def _validate_nest(program: Program, nest_index: int, machine: MachineConfig):
     """Check the skip-free-reuse precondition for one nest (see module
     docstring fact 2). Raises NotImplementedError when the periodic
@@ -459,7 +458,7 @@ def _window_kernel(nt: NestTrace, max_share: int, pair: bool):
     return jax.jit(_window_kernel_body(nt, max_share, pair))
 
 
-@functools.lru_cache(maxsize=32)
+@telemetry.counted_lru_cache(maxsize=32)
 def _compiled_nest(program: Program, nest_index: int,
                    machine: MachineConfig, max_share: int):
     trace = _validate_nest(program, nest_index, machine)
@@ -470,7 +469,7 @@ def _compiled_nest(program: Program, nest_index: int,
     }
 
 
-@functools.lru_cache(maxsize=32)
+@telemetry.counted_lru_cache(maxsize=32)
 def _compiled_nest_batch(program: Program, nest_index: int,
                          machine: MachineConfig, max_share: int):
     """Batched twins of _compiled_nest's window kernels: jit(vmap) over

@@ -404,14 +404,19 @@ def lru_cached(cache: "_collections.OrderedDict", key, build, maxsize: int):
     """Bounded LRU lookup shared by the kernel signature caches here
     and in parallel/sharded.py: each cached closure pins a whole
     NestTrace (incl. tri_base at triangular N) plus compiled
-    executables, so the caches must evict."""
+    executables, so the caches must evict. Hits/misses/evictions land
+    in the active telemetry run's kernel_cache_* counters, the same
+    names the counted functools caches report."""
     entry = cache.get(key)
     if entry is None:
+        telemetry.count("kernel_cache_misses")
         entry = build()
         cache[key] = entry
         while len(cache) > maxsize:
             cache.popitem(last=False)
+            telemetry.count("kernel_cache_evictions")
     else:
+        telemetry.count("kernel_cache_hits")
         cache.move_to_end(key)
     return entry
 
@@ -425,9 +430,16 @@ _SIG_KERNELS_MAX = 64
 
 
 def _kernels_for(nt: NestTrace, ref_idx: int) -> dict:
+    # keyed by the canonical digest of the signature tuple — the same
+    # content-hash discipline the service's result store uses
+    # (service/fingerprint.py::structure_digest); distinctness is
+    # exactly the signature's, so the sharing contract pinned by
+    # tests/test_compile_sharing.py is unchanged
+    from ..service.fingerprint import structure_digest
+
     return lru_cached(
         _SIG_KERNELS,
-        _kernel_sig(nt, ref_idx),
+        structure_digest(_kernel_sig(nt, ref_idx)),
         lambda: {
             "plain": _build_ref_kernel(nt, ref_idx),
             "scan": _build_ref_kernel_scan(nt, ref_idx),
@@ -660,7 +672,7 @@ def per_sample_ri(
     )
 
 
-@functools.lru_cache(maxsize=64)
+@telemetry.counted_lru_cache(maxsize=64)
 def _program_kernels(program: Program, machine: MachineConfig):
     trace = ProgramTrace(program, machine)
     kernels = []

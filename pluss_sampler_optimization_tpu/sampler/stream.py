@@ -27,7 +27,6 @@ streaming. Simulated threads are vmapped as in the dense engine.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -166,7 +165,7 @@ def _stream_nest_kernel(nt: NestTrace, chunk_m: int, max_share: int):
     return run_tid, fresh_carry, n_steps
 
 
-@functools.lru_cache(maxsize=32)
+@telemetry.counted_lru_cache(maxsize=32)
 def _compiled_stream(
     program: Program, machine: MachineConfig, chunk_m: int | None,
     max_share: int,

@@ -1,0 +1,56 @@
+"""Request-level analysis service.
+
+Turns the engines into an on-demand system: content-addressed result
+caching (two-tier, versioned, corruption-tolerant — service/cache.py),
+canonical request fingerprints (service/fingerprint.py), singleflight
+request execution with deadlines and engine degradation
+(service/executor.py), and the submit/result + JSONL serving API
+(service/api.py). CLI entry points: `serve` mode and `--cache-dir`
+(cli.py); store audits: tools/check_service_store.py.
+"""
+
+from .api import (
+    AnalysisRequest,
+    AnalysisResponse,
+    AnalysisService,
+    AnalysisTicket,
+    parse_request_line,
+    serve_jsonl,
+)
+from .cache import STORE_VERSION, ResultCache, validate_record
+from .executor import (
+    DEGRADE_CHAINS,
+    SERVICE_ENGINES,
+    RequestExecutor,
+    default_runner,
+    execute_request,
+)
+from .fingerprint import (
+    FINGERPRINT_VERSION,
+    canonical_json,
+    content_digest,
+    request_fingerprint,
+    structure_digest,
+)
+
+__all__ = [
+    "AnalysisRequest",
+    "AnalysisResponse",
+    "AnalysisService",
+    "AnalysisTicket",
+    "parse_request_line",
+    "serve_jsonl",
+    "STORE_VERSION",
+    "ResultCache",
+    "validate_record",
+    "DEGRADE_CHAINS",
+    "SERVICE_ENGINES",
+    "RequestExecutor",
+    "default_runner",
+    "execute_request",
+    "FINGERPRINT_VERSION",
+    "canonical_json",
+    "content_digest",
+    "request_fingerprint",
+    "structure_digest",
+]

@@ -1,0 +1,300 @@
+"""AnalysisService: the request-level serving API.
+
+`AnalysisService` owns one two-tier result cache and one request
+executor; `submit()` returns a ticket immediately and `result()`
+blocks for the response (`analyze()` is both). Identical concurrent
+submissions coalesce to one engine execution; warm repeats are served
+from the content-addressed store with zero engine work and a
+bit-identical MRC (the acceptance invariants, pinned by
+tests/test_service.py through telemetry counters).
+
+`serve_jsonl` is the CLI `serve` mode's engine: it reads one JSON
+request per line, submits the whole batch up front (so duplicate
+requests inside a batch coalesce), then emits one JSON response per
+request in input order. Request schema (README "Serving"):
+
+    {"id": "r1", "model": "gemm", "n": 128, "engine": "exact",
+     "threads": 4, "chunk": 4, "ratio": 0.1, "seed": 0,
+     "deadline_s": 30.0}
+
+Every field except `model` has a default; unknown fields are an error
+response for that line, never a crash of the batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import IO
+
+import numpy as np
+
+from ..config import MachineConfig
+from ..ir import Program
+from ..models import build as build_model
+from .cache import ResultCache
+from .executor import (
+    SERVICE_ENGINES,
+    RequestExecutor,
+    default_runner,
+)
+from .fingerprint import request_fingerprint
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisRequest:
+    """One analysis request. `id` and `deadline_s` are serving
+    metadata — they identify/bound the request but do not change the
+    result, so they stay OUT of the fingerprint and the stored record.
+    """
+
+    model: str
+    n: int = 128
+    tsteps: int = 1
+    engine: str = "exact"
+    runtime: str = "v1"
+    threads: int = 4
+    chunk: int = 4
+    ds: int = 8
+    cls: int = 64
+    cache_kb: int = 2560
+    ratio: float = 0.1
+    seed: int = 0
+    device_draw: bool | None = None
+    deadline_s: float | None = None
+    id: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.engine not in SERVICE_ENGINES:
+            raise ValueError(
+                f"unknown service engine {self.engine!r} "
+                f"(have {', '.join(SERVICE_ENGINES)})"
+            )
+        if self.runtime not in ("v1", "v2"):
+            raise ValueError("runtime must be 'v1' or 'v2'")
+
+    def build_program(self) -> Program:
+        return build_model(self.model, self.n, self.tsteps)
+
+    def machine(self) -> MachineConfig:
+        return MachineConfig(
+            thread_num=self.threads, chunk_size=self.chunk,
+            ds=self.ds, cls=self.cls, cache_kb=self.cache_kb,
+        )
+
+    def params(self) -> dict:
+        """Engine parameters that shape the RESULT, and only those: an
+        exact request's fingerprint must not vary with sampling knobs
+        it never reads."""
+        p: dict = {}
+        if self.engine in ("oracle", "sampled"):
+            p["runtime"] = self.runtime
+        if self.engine == "sampled":
+            p["ratio"] = self.ratio
+            p["seed"] = self.seed
+            # the requested selector (None = per-backend auto); the
+            # two draw paths yield different deterministic sample
+            # sets, so an explicit choice must split the address
+            p["device_draw"] = self.device_draw
+        return p
+
+    def payload(self) -> dict:
+        """The request as stored in the result record (no serving
+        metadata)."""
+        d = dataclasses.asdict(self)
+        d.pop("id")
+        d.pop("deadline_s")
+        return d
+
+    def fingerprint(self, program: Program | None = None) -> str:
+        return request_fingerprint(
+            program if program is not None else self.build_program(),
+            self.machine(),
+            self.engine,
+            self.params(),
+        )
+
+
+@dataclasses.dataclass
+class AnalysisTicket:
+    request: AnalysisRequest
+    fingerprint: str
+    future: object  # concurrent.futures.Future resolving to a dict
+
+
+@dataclasses.dataclass
+class AnalysisResponse:
+    id: str | None
+    ok: bool
+    fingerprint: str | None
+    engine_requested: str | None
+    engine_used: str | None
+    cache: str | None  # "mem" | "disk" | "miss"
+    degraded: list
+    latency_s: float | None
+    total_accesses: int | None
+    access_label: str | None
+    mrc: "np.ndarray | None"
+    rih: dict | None  # int key -> count
+    dump_lines: list | None
+    per_ref_lines: list | None
+    error: str | None
+
+    def to_jsonl_dict(self) -> dict:
+        """The wire form `serve` emits: compact — the MRC ships in the
+        reference's run-length print form (runtime/report.py), not as
+        the dense curve (cache_lines can reach 327k entries)."""
+        from ..runtime import report
+
+        d: dict = {
+            "id": self.id,
+            "ok": self.ok,
+            "fingerprint": self.fingerprint,
+            "engine_requested": self.engine_requested,
+            "engine_used": self.engine_used,
+            "cache": self.cache,
+            "degraded": self.degraded,
+            "latency_s": self.latency_s,
+            "total_accesses": self.total_accesses,
+            "access_label": self.access_label,
+        }
+        if self.mrc is not None:
+            d["mrc_len"] = int(len(self.mrc))
+            d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
+        if self.error is not None:
+            d["error"] = self.error
+        return d
+
+
+def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
+                           outcome: dict) -> AnalysisResponse:
+    record = outcome.get("record")
+    if record is None:
+        return AnalysisResponse(
+            id=request.id, ok=False, fingerprint=fingerprint,
+            engine_requested=request.engine, engine_used=None,
+            cache=outcome.get("cache"),
+            degraded=outcome.get("degraded") or [],
+            latency_s=outcome.get("latency_s"),
+            total_accesses=None, access_label=None, mrc=None,
+            rih=None, dump_lines=None, per_ref_lines=None,
+            error=outcome.get("error") or "execution failed",
+        )
+    return AnalysisResponse(
+        id=request.id,
+        ok=True,
+        fingerprint=fingerprint,
+        engine_requested=request.engine,
+        engine_used=record["engine_used"],
+        cache=outcome.get("cache"),
+        degraded=outcome.get("degraded") or [],
+        latency_s=outcome.get("latency_s"),
+        total_accesses=record["total_accesses"],
+        access_label=record["access_label"],
+        mrc=np.asarray(record["mrc"], dtype=np.float64),
+        rih={int(k): v for k, v in record["rih"].items()},
+        dump_lines=list(record["dump_lines"]),
+        per_ref_lines=list(record.get("per_ref_lines", [])) or None,
+        error=None,
+    )
+
+
+class AnalysisService:
+    """submit()/result() over the cache + executor pair."""
+
+    def __init__(self, cache_dir: str | None = None,
+                 max_workers: int = 4, mem_entries: int = 128,
+                 runner=default_runner):
+        self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
+        self.executor = RequestExecutor(
+            self.cache, max_workers=max_workers, runner=runner
+        )
+
+    def submit(self, request: AnalysisRequest) -> AnalysisTicket:
+        """Validate, fingerprint, and schedule (or join) a request.
+        Raises ValueError/KeyError for malformed requests — `serve`
+        turns those into per-line error responses."""
+        program = request.build_program()
+        fp = request.fingerprint(program)
+        fut = self.executor.submit(
+            request, program, request.machine(), fp
+        )
+        return AnalysisTicket(request=request, fingerprint=fp,
+                              future=fut)
+
+    def result(self, ticket: AnalysisTicket,
+               timeout: float | None = None) -> AnalysisResponse:
+        outcome = ticket.future.result(timeout=timeout)
+        return _response_from_outcome(
+            ticket.request, ticket.fingerprint, outcome
+        )
+
+    def analyze(self, request: AnalysisRequest,
+                timeout: float | None = None) -> AnalysisResponse:
+        return self.result(self.submit(request), timeout=timeout)
+
+    def close(self) -> None:
+        self.executor.shutdown()
+
+    def __enter__(self) -> "AnalysisService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def parse_request_line(line: str) -> AnalysisRequest:
+    doc = json.loads(line)
+    if not isinstance(doc, dict):
+        raise ValueError("request line must be a JSON object")
+    fields = {f.name for f in dataclasses.fields(AnalysisRequest)}
+    unknown = set(doc) - fields
+    if unknown:
+        raise ValueError(
+            f"unknown request fields: {', '.join(sorted(unknown))}"
+        )
+    if "model" not in doc:
+        raise ValueError("request needs a 'model'")
+    return AnalysisRequest(**doc)
+
+
+def serve_jsonl(service: AnalysisService, in_stream: IO,
+                out_stream: IO) -> int:
+    """Process one JSONL request batch; returns the failure count.
+
+    All parseable requests are submitted BEFORE any result is awaited,
+    so duplicates inside the batch coalesce onto one execution, and
+    responses come out in input order regardless of completion order.
+    """
+    entries: list = []  # (line_no, request|None, ticket|None, error)
+    for line_no, line in enumerate(in_stream, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = parse_request_line(line)
+            ticket = service.submit(request)
+            entries.append((line_no, request, ticket, None))
+        except Exception as e:
+            # KeyError's str() wraps the message in repr quotes;
+            # prefer the raw message for every single-arg exception
+            msg = str(e.args[0]) if len(e.args) == 1 else str(e)
+            entries.append((line_no, None, None, msg))
+    failures = 0
+    for line_no, request, ticket, error in entries:
+        if ticket is None:
+            failures += 1
+            doc = {
+                "id": (request.id if request else None),
+                "ok": False,
+                "line": line_no,
+                "error": error,
+            }
+        else:
+            response = service.result(ticket)
+            if not response.ok:
+                failures += 1
+            doc = response.to_jsonl_dict()
+        out_stream.write(json.dumps(doc) + "\n")
+        out_stream.flush()
+    return failures

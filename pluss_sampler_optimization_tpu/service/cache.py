@@ -1,0 +1,184 @@
+"""Two-tier content-addressed result store.
+
+Tier 1 is a bounded in-memory LRU (OrderedDict, same discipline as the
+kernel signature caches in sampler/sampled.py); tier 2 is an on-disk
+store addressed by fingerprint — `<dir>/<fp[:2]>/<fp>.json`, the
+standard content-address fan-out so a hot directory never accumulates
+hundreds of thousands of siblings.
+
+Records are versioned JSON (STORE_VERSION) written atomically
+(runtime/io.py::atomic_write_json — a killed process never leaves a
+truncated record). Loads are corruption-tolerant by contract: any
+unreadable/unparseable/wrong-version/mis-addressed record is a MISS
+(counted as `service_cache_corrupt`), never an exception — the
+executor simply recomputes and overwrites. `tools/check_service_store.py`
+audits and garbage-collects a store offline with the same validation.
+
+Telemetry: `service_cache_hit_mem` / `service_cache_hit_disk` /
+`service_cache_miss` / `service_cache_corrupt` /
+`service_cache_evictions` counters land in the active run, so a serve
+session's JSON export shows its hit ratio next to the engines' own
+dispatch counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+
+from ..runtime import telemetry
+from ..runtime.io import atomic_write_json
+
+# Version of the RESULT RECORD shape (the dict produced by
+# service/executor.py::execute_request). Bump together with any change
+# to that shape; fingerprint.FINGERPRINT_VERSION covers the KEY side.
+STORE_VERSION = 1
+
+# Keys every stored record must carry to be served from cache.
+REQUIRED_KEYS = (
+    "store_version",
+    "fingerprint",
+    "engine_used",
+    "total_accesses",
+    "access_label",
+    "rih",
+    "mrc",
+    "dump_lines",
+    "created_at",
+)
+
+
+def validate_record(record, fingerprint: str | None = None) -> list[str]:
+    """All schema violations of one parsed record (empty = valid).
+
+    Single source of truth for the in-process load path AND the
+    offline store checker (tools/check_service_store.py), exactly the
+    pattern tools/check_telemetry_schema.py::validate set.
+    """
+    errors: list[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("store_version") != STORE_VERSION:
+        errors.append(
+            f"store_version must be {STORE_VERSION}, got "
+            f"{record.get('store_version')!r}"
+        )
+    for key in REQUIRED_KEYS:
+        if key not in record:
+            errors.append(f"missing required key '{key}'")
+    if fingerprint is not None and record.get("fingerprint") != fingerprint:
+        errors.append(
+            f"fingerprint mismatch: record says "
+            f"{record.get('fingerprint')!r}, address is {fingerprint!r}"
+        )
+    mrc = record.get("mrc")
+    if not (
+        isinstance(mrc, list)
+        and all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in mrc
+        )
+    ):
+        errors.append("'mrc' must be a list of numbers")
+    rih = record.get("rih")
+    if not (
+        isinstance(rih, dict)
+        and all(
+            isinstance(k, str)
+            and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+            for k, v in rih.items()
+        )
+    ):
+        errors.append("'rih' must be an object of numeric counts")
+    if not isinstance(record.get("dump_lines"), list) or not all(
+        isinstance(ln, str) for ln in record.get("dump_lines", [])
+    ):
+        errors.append("'dump_lines' must be a list of strings")
+    ta = record.get("total_accesses")
+    if not isinstance(ta, (int, float)) or isinstance(ta, bool):
+        errors.append("'total_accesses' must be a number")
+    if not isinstance(record.get("engine_used"), str):
+        errors.append("'engine_used' must be a string")
+    return errors
+
+
+class ResultCache:
+    """Thread-safe two-tier store; `cache_dir=None` is memory-only."""
+
+    def __init__(self, cache_dir: str | None = None,
+                 mem_entries: int = 128):
+        self.cache_dir = os.fspath(cache_dir) if cache_dir else None
+        self.mem_entries = mem_entries
+        self._mem: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        if self.cache_dir:
+            os.makedirs(self.cache_dir, exist_ok=True)
+
+    def path_for(self, fingerprint: str) -> str:
+        if not self.cache_dir:
+            raise ValueError("cache has no disk tier")
+        return os.path.join(
+            self.cache_dir, fingerprint[:2], fingerprint + ".json"
+        )
+
+    # -- lookup -------------------------------------------------------
+
+    def get(self, fingerprint: str):
+        """(record, tier) with tier in {"mem", "disk"}, or (None,
+        "miss"). Corrupt disk entries are misses; the caller
+        recomputes and `put` overwrites them."""
+        with self._lock:
+            rec = self._mem.get(fingerprint)
+            if rec is not None:
+                self._mem.move_to_end(fingerprint)
+                telemetry.count("service_cache_hit_mem")
+                return rec, "mem"
+        if self.cache_dir:
+            rec = self._load_disk(fingerprint)
+            if rec is not None:
+                with self._lock:
+                    self._mem_put(fingerprint, rec)
+                telemetry.count("service_cache_hit_disk")
+                return rec, "disk"
+        telemetry.count("service_cache_miss")
+        return None, "miss"
+
+    def _load_disk(self, fingerprint: str):
+        path = self.path_for(fingerprint)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            telemetry.count("service_cache_corrupt")
+            return None
+        if validate_record(rec, fingerprint):
+            telemetry.count("service_cache_corrupt")
+            return None
+        return rec
+
+    # -- store --------------------------------------------------------
+
+    def put(self, fingerprint: str, record: dict) -> None:
+        with self._lock:
+            self._mem_put(fingerprint, record)
+        if self.cache_dir:
+            path = self.path_for(fingerprint)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            try:
+                atomic_write_json(path, record)
+            except OSError:
+                # a full/readonly disk degrades to memory-only serving;
+                # the result itself still reaches the caller
+                telemetry.count("service_cache_write_failed")
+
+    def _mem_put(self, fingerprint: str, record: dict) -> None:
+        self._mem[fingerprint] = record
+        self._mem.move_to_end(fingerprint)
+        while len(self._mem) > self.mem_entries:
+            self._mem.popitem(last=False)
+            telemetry.count("service_cache_evictions")

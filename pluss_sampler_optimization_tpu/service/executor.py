@@ -1,0 +1,350 @@
+"""Request execution: engines behind a degradation chain, under
+singleflight coalescing, bounded concurrency, and per-request
+deadlines.
+
+This is the layer that turns "a sampler you run" into "a service you
+query":
+
+- **One pipeline per request.** `execute_request` runs the selected
+  engine, folds the state through the reference pipeline
+  (cri_distribute -> aet_mrc), and assembles the versioned result
+  record service/cache.py stores — including the byte-exact acc dump
+  lines, so a cache hit can serve the CLI's accuracy protocol without
+  touching an engine.
+- **Deadline-driven degradation.** Each request may carry a deadline;
+  when the preferred engine fails or overruns it, the executor falls
+  down the chain (exact -> sampled, periodic -> analytic -> sampled,
+  ...) and records every downgrade in the response AND as a
+  `service_degraded` telemetry event. An overrun attempt is abandoned
+  (its thread finishes into the void — Python cannot cancel a running
+  XLA dispatch), counted as `service_deadline_abandoned`. Degraded
+  results are NOT written to the persistent cache: the fingerprint
+  addresses the canonical result of the REQUESTED engine, and a
+  sampled stand-in must not masquerade as it on the next warm hit.
+- **Singleflight.** N identical in-flight requests coalesce onto one
+  execution future keyed by fingerprint; every caller shares the one
+  result (counted as `service_coalesced`). Combined with the cache
+  this gives the acceptance invariant: a warm repeat performs ZERO
+  engine executions, and N concurrent identical submissions perform
+  exactly ONE.
+- **Bounded concurrency.** A ThreadPoolExecutor caps concurrent
+  pipelines; `service_queue_depth` gauges the in-flight count.
+
+The engine table and the runner hook are module-level / constructor
+injection points so tests can wrap them (e.g. add a barrier to force
+overlap, or a sleep to force a deadline) without monkeypatching
+engine internals.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..config import MachineConfig, SamplerConfig
+from ..ir import Program
+from ..runtime import report, telemetry
+from ..runtime.aet import aet_mrc
+from ..runtime.cri import cri_distribute
+from .cache import STORE_VERSION, ResultCache
+
+# Fallback order per requested engine: the exact family degrades
+# toward the sampled engine (cheap, approximate, always applicable).
+# Engines absent here (oracle, numpy, sampled, ...) have no fallback —
+# a failure is the response's error.
+DEGRADE_CHAINS = {
+    "exact": ("exact", "sampled"),
+    "periodic": ("periodic", "analytic", "sampled"),
+    "analytic": ("analytic", "sampled"),
+    "dense": ("dense", "stream", "sampled"),
+    "stream": ("stream", "sampled"),
+}
+
+SERVICE_ENGINES = (
+    "oracle", "numpy", "dense", "stream", "periodic", "analytic",
+    "exact", "sampled",
+)
+
+
+def degrade_chain(engine: str) -> tuple[str, ...]:
+    return DEGRADE_CHAINS.get(engine, (engine,))
+
+
+def default_runner(engine: str, program: Program,
+                   machine: MachineConfig, request):
+    """Run one engine -> (result-with-.state/.total_accesses, per_ref).
+
+    The same engine dispatch cli.py::_run_engine performs, restricted
+    to the service's request schema (no r10/checkpoint/shard knobs)."""
+    v2 = request.runtime == "v2"
+    if engine == "oracle":
+        from ..oracle.serial import run_serial
+
+        return run_serial(program, machine, v2=v2), None
+    if engine == "numpy":
+        from ..oracle.numpy_ref import run_numpy
+
+        return run_numpy(program, machine), None
+    if engine == "dense":
+        from ..sampler.dense import run_dense
+
+        return run_dense(program, machine), None
+    if engine == "stream":
+        from ..sampler.stream import run_stream
+
+        return run_stream(program, machine), None
+    if engine == "periodic":
+        from ..sampler.periodic import run_periodic
+
+        return run_periodic(program, machine), None
+    if engine == "analytic":
+        from ..sampler.analytic import run_analytic
+
+        return run_analytic(program, machine), None
+    if engine == "exact":
+        from ..sampler.periodic import run_exact
+
+        return run_exact(program, machine), None
+    if engine == "sampled":
+        import types
+
+        from ..sampler.sampled import run_sampled
+
+        kw = {}
+        if request.device_draw is not None:
+            kw["device_draw"] = request.device_draw
+        cfg = SamplerConfig(
+            ratio=request.ratio, seed=request.seed, **kw
+        )
+        state, results = run_sampled(program, machine, cfg, v2=v2)
+        res = types.SimpleNamespace(
+            state=state,
+            total_accesses=sum(r.n_samples for r in results),
+            engine="sampled",
+        )
+        return res, results
+    raise ValueError(f"unknown service engine {engine!r}")
+
+
+def execute_request(request, program: Program, machine: MachineConfig,
+                    engine: str, fingerprint: str,
+                    runner=default_runner) -> dict:
+    """One engine execution folded into a versioned result record.
+
+    `engine` is the chain element actually being attempted (it may
+    differ from request.engine after degradation)."""
+    telemetry.count("service_exec_started")
+    with telemetry.span("service_exec", engine=engine,
+                        program=program.name):
+        res, per_ref = runner(engine, program, machine, request)
+        rih = cri_distribute(
+            res.state, machine.thread_num, machine.thread_num
+        )
+        mrc = aet_mrc(rih, machine)
+    telemetry.count("service_exec_done")
+    label = "samples" if per_ref is not None else "accesses"
+    dump_lines = []
+    dump_lines += report.noshare_dump(res.state)
+    dump_lines += report.share_dump(res.state)
+    dump_lines += report.rih_dump(rih)
+    dump_lines += report.mrc_lines(mrc)
+    dump_lines.append(
+        f"max iteration count: {res.total_accesses} {label}"
+    )
+    record = {
+        "store_version": STORE_VERSION,
+        "fingerprint": fingerprint,
+        "request": request.payload(),
+        "engine_requested": request.engine,
+        "engine_used": getattr(res, "engine", None) or engine,
+        "total_accesses": int(res.total_accesses),
+        "access_label": label,
+        "rih": {str(k): float(v) for k, v in sorted(rih.items())},
+        "mrc": [float(v) for v in mrc],
+        "dump_lines": dump_lines,
+        "created_at": time.time(),
+    }
+    if per_ref is not None:
+        record["per_ref_lines"] = [
+            f"ref {r.name}: {r.n_samples} samples, cold {r.cold:g}"
+            for r in per_ref
+        ]
+    return record
+
+
+class RequestExecutor:
+    """Singleflight + bounded concurrency + deadlines over
+    `execute_request`. One instance backs one AnalysisService."""
+
+    def __init__(self, cache: ResultCache | None = None,
+                 max_workers: int = 4, runner=default_runner):
+        self.cache = cache if cache is not None else ResultCache()
+        self.runner = runner
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="pluss-service",
+        )
+        self._inflight: dict[str, Future] = {}
+        self._lock = threading.Lock()
+
+    # -- public -------------------------------------------------------
+
+    def submit(self, request, program: Program,
+               machine: MachineConfig, fingerprint: str) -> Future:
+        """Schedule (or join) the execution for one fingerprint.
+
+        The returned future resolves to the full response dict (record
+        + serving metadata). Identical fingerprints submitted while
+        one is in flight share its future."""
+        telemetry.count("service_requests")
+        with self._lock:
+            fut = self._inflight.get(fingerprint)
+            if fut is not None:
+                telemetry.count("service_coalesced")
+                return fut
+            fut = self._pool.submit(
+                self._process, request, program, machine, fingerprint
+            )
+            self._inflight[fingerprint] = fut
+            telemetry.gauge("service_queue_depth", len(self._inflight))
+
+        def _done(_f, fp=fingerprint):
+            with self._lock:
+                self._inflight.pop(fp, None)
+                telemetry.gauge(
+                    "service_queue_depth", len(self._inflight)
+                )
+
+        # registered OUTSIDE the lock: a future that already finished
+        # runs the callback synchronously on this thread, and the
+        # callback itself takes the lock
+        fut.add_done_callback(_done)
+        return fut
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    # -- worker -------------------------------------------------------
+
+    def _process(self, request, program, machine,
+                 fingerprint: str) -> dict:
+        t0 = time.perf_counter()
+        with telemetry.span("service_request", engine=request.engine,
+                            program=program.name):
+            record, tier = self.cache.get(fingerprint)
+            degraded: list[dict] = []
+            error = None
+            if record is None:
+                record, degraded, error = self._run_chain(
+                    request, program, machine, fingerprint
+                )
+                if record is not None and not degraded:
+                    self.cache.put(fingerprint, record)
+        return {
+            "record": record,
+            "cache": tier,
+            "degraded": degraded,
+            "error": error,
+            "latency_s": round(time.perf_counter() - t0, 6),
+        }
+
+    def _run_chain(self, request, program, machine, fingerprint):
+        """Walk the degradation chain under the request deadline.
+        Returns (record|None, degraded events, error|None)."""
+        chain = degrade_chain(request.engine)
+        deadline = (
+            None if request.deadline_s is None
+            else time.perf_counter() + request.deadline_s
+        )
+        degraded: list[dict] = []
+        last_error = None
+        for i, engine in enumerate(chain):
+            is_last = i == len(chain) - 1
+            remaining = (
+                None if deadline is None
+                else deadline - time.perf_counter()
+            )
+            if remaining is not None and remaining <= 0 and not is_last:
+                # budget already spent: jump toward the cheapest
+                # engine rather than starting one we would abandon
+                self._note_degrade(
+                    degraded, fingerprint, engine, chain[i + 1],
+                    "deadline exhausted before attempt",
+                )
+                continue
+            try:
+                if remaining is None or is_last:
+                    # no budget to enforce (or nothing to fall back
+                    # to): run inline on this worker
+                    return (
+                        execute_request(
+                            request, program, machine, engine,
+                            fingerprint, self.runner,
+                        ),
+                        degraded,
+                        None,
+                    )
+                record = self._attempt_with_timeout(
+                    request, program, machine, engine, fingerprint,
+                    remaining,
+                )
+                if record is not None:
+                    return record, degraded, None
+                self._note_degrade(
+                    degraded, fingerprint, engine, chain[i + 1],
+                    f"deadline {request.deadline_s}s overrun",
+                )
+            except Exception as e:
+                last_error = repr(e)
+                telemetry.count("service_exec_failed")
+                if is_last:
+                    return None, degraded, last_error
+                self._note_degrade(
+                    degraded, fingerprint, engine, chain[i + 1],
+                    f"engine failed: {last_error[:200]}",
+                )
+        return None, degraded, last_error or "no engine attempted"
+
+    def _attempt_with_timeout(self, request, program, machine, engine,
+                              fingerprint, budget_s: float):
+        """Run one attempt in a side thread and wait at most budget_s.
+        None = overrun (the attempt thread is abandoned; Python offers
+        no preemption, so its work completes unobserved)."""
+        box: dict = {}
+
+        def target():
+            try:
+                box["record"] = execute_request(
+                    request, program, machine, engine, fingerprint,
+                    self.runner,
+                )
+            except Exception as e:
+                box["error"] = e
+
+        t = threading.Thread(
+            target=target, daemon=True,
+            name=f"pluss-service-attempt-{engine}",
+        )
+        t.start()
+        t.join(budget_s)
+        if t.is_alive():
+            telemetry.count("service_deadline_abandoned")
+            return None
+        if "error" in box:
+            raise box["error"]
+        return box["record"]
+
+    @staticmethod
+    def _note_degrade(degraded, fingerprint, from_engine, to_engine,
+                      reason: str) -> None:
+        info = {
+            "from": from_engine,
+            "to": to_engine,
+            "reason": reason,
+        }
+        degraded.append(info)
+        telemetry.count("service_degraded")
+        telemetry.event(
+            "service_degraded", fingerprint=fingerprint, **info
+        )

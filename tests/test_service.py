@@ -1,0 +1,504 @@
+"""Analysis service: fingerprints, the two-tier result store,
+singleflight coalescing, deadline degradation, the serve/--cache-dir
+CLI surface, atomic sidecar writes, and the store checker.
+
+The ISSUE-3 acceptance invariants are pinned here through telemetry
+counters: a warm-cache repeat returns a bit-identical MRC with ZERO
+engine executions, and N identical concurrent submissions trigger
+exactly ONE.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.models import REGISTRY, build
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime.io import (
+    atomic_write_json,
+    atomic_write_text,
+)
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisRequest,
+    AnalysisService,
+    ResultCache,
+    serve_jsonl,
+    structure_digest,
+    validate_record,
+)
+from pluss_sampler_optimization_tpu.service.executor import (
+    default_runner,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+import check_service_store  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _req(**kw):
+    base = dict(model="gemm", n=16, engine="oracle")
+    base.update(kw)
+    return AnalysisRequest(**base)
+
+
+# -- fingerprints -----------------------------------------------------
+
+
+def test_fingerprint_stable_and_sensitive():
+    fp = _req().fingerprint()
+    assert fp == _req().fingerprint()  # deterministic
+    assert len(fp) == 64 and set(fp) <= set("0123456789abcdef")
+    # anything that changes the result changes the address
+    assert _req(n=17).fingerprint() != fp
+    assert _req(engine="dense").fingerprint() != fp
+    assert _req(threads=8).fingerprint() != fp
+    assert _req(cache_kb=1280).fingerprint() != fp
+    # serving metadata must NOT change the address
+    assert _req(id="abc", deadline_s=5.0).fingerprint() == fp
+    # sampling knobs are hashed only for the engines that read them
+    assert _req(ratio=0.5).fingerprint() == fp
+    s = _req(engine="sampled")
+    assert s.fingerprint() != fp
+    assert _req(engine="sampled", seed=1).fingerprint() != s.fingerprint()
+    assert _req(engine="sampled", ratio=0.2).fingerprint() != (
+        s.fingerprint()
+    )
+
+
+def test_fingerprint_hashes_program_ir_not_model_name():
+    """Two registry names building the same IR share one address; the
+    fingerprint is a function of the Program, not its lookup key."""
+    from pluss_sampler_optimization_tpu.service.fingerprint import (
+        request_fingerprint,
+    )
+
+    prog = build("gemm", 16)
+    machine = _req().machine()
+    a = request_fingerprint(prog, machine, "oracle", {"runtime": "v1"})
+    b = request_fingerprint(
+        build("gemm", 16), machine, "oracle", {"runtime": "v1"}
+    )
+    assert a == b
+
+
+def test_structure_digest_distinguishes_and_repeats():
+    sig1 = (1, (2, 3), "pre", None, True)
+    sig2 = (1, (2, 4), "pre", None, True)
+    assert structure_digest(sig1) == structure_digest(sig1)
+    assert structure_digest(sig1) != structure_digest(sig2)
+
+
+def test_unknown_engine_rejected():
+    with pytest.raises(ValueError):
+        AnalysisRequest(model="gemm", engine="bogus")
+
+
+# -- result cache -----------------------------------------------------
+
+
+def _fake_record(fp):
+    return {
+        "store_version": 1,
+        "fingerprint": fp,
+        "request": {"model": "gemm"},
+        "engine_requested": "oracle",
+        "engine_used": "oracle",
+        "total_accesses": 10,
+        "access_label": "accesses",
+        "rih": {"1": 2.0},
+        "mrc": [1.0, 0.5],
+        "dump_lines": ["miss ratio"],
+        "created_at": time.time(),
+    }
+
+
+def test_cache_two_tiers_and_corruption_tolerance(tmp_path):
+    cache = ResultCache(str(tmp_path / "store"), mem_entries=4)
+    fp = "ab" + "0" * 62
+    assert cache.get(fp) == (None, "miss")
+    cache.put(fp, _fake_record(fp))
+    rec, tier = cache.get(fp)
+    assert tier == "mem" and rec["mrc"] == [1.0, 0.5]
+    # a fresh cache over the same dir reads the disk tier
+    cache2 = ResultCache(str(tmp_path / "store"))
+    rec, tier = cache2.get(fp)
+    assert tier == "disk" and rec["fingerprint"] == fp
+    # truncated JSON = miss, never an exception
+    path = cache.path_for(fp)
+    with open(path, "w") as f:
+        f.write('{"store_version": 1, "finge')
+    rec, tier = ResultCache(str(tmp_path / "store")).get(fp)
+    assert (rec, tier) == (None, "miss")
+    # wrong version = miss
+    bad = _fake_record(fp)
+    bad["store_version"] = 999
+    with open(path, "w") as f:
+        json.dump(bad, f)
+    assert ResultCache(str(tmp_path / "store")).get(fp) == (
+        None, "miss"
+    )
+    # mis-addressed record (fingerprint != filename) = miss
+    other = _fake_record("cd" + "1" * 62)
+    with open(path, "w") as f:
+        json.dump(other, f)
+    assert ResultCache(str(tmp_path / "store")).get(fp) == (
+        None, "miss"
+    )
+
+
+def test_cache_mem_eviction_counted(tmp_path):
+    tele = telemetry.enable()
+    cache = ResultCache(None, mem_entries=2)
+    for i in range(4):
+        fp = f"{i:02d}" + "0" * 62
+        cache.put(fp, _fake_record(fp))
+    telemetry.disable()
+    assert tele.counters.get("service_cache_evictions") == 2
+
+
+def test_validate_record_catches_shape_drift():
+    fp = "ab" + "0" * 62
+    assert validate_record(_fake_record(fp), fp) == []
+    bad = _fake_record(fp)
+    del bad["mrc"]
+    assert any("mrc" in e for e in validate_record(bad, fp))
+    bad = _fake_record(fp)
+    bad["rih"] = {"1": "two"}
+    assert validate_record(bad, fp)
+
+
+# -- the acceptance invariants ---------------------------------------
+
+
+def test_warm_repeat_bit_identical_mrc_zero_executions(tmp_path):
+    """Warm repeats: bit-identical MRC, zero engine executions —
+    through the memory tier, AND through the disk tier of a fresh
+    service instance."""
+    tele = telemetry.enable()
+    req = _req()
+    with AnalysisService(cache_dir=str(tmp_path / "store")) as svc:
+        cold = svc.analyze(req)
+        assert cold.ok and cold.cache == "miss"
+        assert tele.counters.get("service_exec_started") == 1
+        snapshot = dict(tele.counters)
+        warm = svc.analyze(req)
+    assert warm.ok and warm.cache == "mem"
+    assert tele.counters.get("service_exec_started") == 1
+    # zero engine work of ANY kind on the warm path: no counter moved
+    # except the service's own bookkeeping
+    moved = {
+        k for k in set(tele.counters) | set(snapshot)
+        if tele.counters.get(k, 0) != snapshot.get(k, 0)
+    }
+    assert all(k.startswith("service_") for k in moved), moved
+    assert warm.mrc.dtype == np.float64
+    assert np.array_equal(cold.mrc, warm.mrc)
+    assert warm.dump_lines == cold.dump_lines
+
+    with AnalysisService(cache_dir=str(tmp_path / "store")) as svc2:
+        disk = svc2.analyze(req)
+    telemetry.disable()
+    assert disk.ok and disk.cache == "disk"
+    assert tele.counters.get("service_exec_started") == 1
+    assert np.array_equal(cold.mrc, disk.mrc)
+
+
+def test_identical_concurrent_requests_coalesce_to_one_execution():
+    """N identical + M distinct requests fired from threads: exactly
+    one execution per distinct fingerprint (telemetry dispatch
+    counters), every caller gets the full result."""
+    release = threading.Event()
+
+    def slow_runner(engine, program, machine, request):
+        release.wait(timeout=30)
+        return default_runner(engine, program, machine, request)
+
+    tele = telemetry.enable()
+    reqs = (
+        [_req() for _ in range(8)]
+        + [_req(n=18) for _ in range(4)]
+        + [_req(model="mvt", n=12) for _ in range(4)]
+    )
+    with AnalysisService(max_workers=4, runner=slow_runner) as svc:
+        responses = [None] * len(reqs)
+
+        def call(i):
+            responses[i] = svc.analyze(reqs[i])
+
+        threads = [
+            threading.Thread(target=call, args=(i,))
+            for i in range(len(reqs))
+        ]
+        for t in threads:
+            t.start()
+        # let every submit land (they coalesce in submit, before any
+        # worker can finish: workers are parked on the event)
+        deadline = time.time() + 30
+        while len(svc.executor._inflight) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        release.set()
+        for t in threads:
+            t.join(timeout=60)
+    telemetry.disable()
+    assert all(r is not None and r.ok for r in responses)
+    assert tele.counters.get("service_exec_started") == 3
+    # every non-executing request either joined an in-flight future or
+    # (if it submitted after completion) hit the memory tier
+    assert (
+        tele.counters.get("service_coalesced", 0)
+        + tele.counters.get("service_cache_hit_mem", 0)
+    ) == 13
+    # coalesced callers share bit-identical results per fingerprint
+    for group in (responses[:8], responses[8:12], responses[12:]):
+        base = group[0]
+        for r in group[1:]:
+            assert r.fingerprint == base.fingerprint
+            assert np.array_equal(r.mrc, base.mrc)
+    fps = {r.fingerprint for r in responses}
+    assert len(fps) == 3
+
+
+def test_deadline_degrades_and_skips_persistent_cache(tmp_path):
+    """An exact engine overrunning its deadline degrades to sampled;
+    the downgrade is recorded in the response and as a telemetry
+    event, and the degraded result is NOT persisted (the fingerprint
+    addresses the canonical result of the requested engine)."""
+
+    def stalling_runner(engine, program, machine, request):
+        if engine == "exact":
+            # overrun the deadline, then abort: the abandoned attempt
+            # thread must not run an engine after this test finishes
+            # (it would pollute a later test's telemetry run)
+            time.sleep(2)
+            raise RuntimeError("stalled attempt aborted")
+        return default_runner(engine, program, machine, request)
+
+    tele = telemetry.enable()
+    req = _req(model="gemm", n=8, engine="exact", ratio=0.3,
+               deadline_s=0.3)
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"), runner=stalling_runner
+    ) as svc:
+        resp = svc.analyze(req)
+    telemetry.disable()
+    assert resp.ok
+    assert resp.engine_used == "sampled"
+    assert resp.degraded and resp.degraded[0]["from"] == "exact"
+    assert resp.degraded[0]["to"] == "sampled"
+    assert tele.counters.get("service_degraded") == 1
+    assert tele.counters.get("service_deadline_abandoned") == 1
+    assert any(
+        e["name"] == "service_degraded" for e in tele.events
+    )
+    # nothing persisted under the request's address
+    assert svc.cache._load_disk(resp.fingerprint) is None
+
+
+def test_engine_failure_falls_down_the_chain():
+    def broken_runner(engine, program, machine, request):
+        if engine != "sampled":
+            raise RuntimeError(f"{engine} exploded")
+        return default_runner(engine, program, machine, request)
+
+    tele = telemetry.enable()
+    req = _req(model="gemm", n=8, engine="exact", ratio=0.3)
+    with AnalysisService(runner=broken_runner) as svc:
+        resp = svc.analyze(req)
+    telemetry.disable()
+    assert resp.ok and resp.engine_used == "sampled"
+    assert resp.degraded and "exploded" in resp.degraded[0]["reason"]
+    assert tele.counters.get("service_exec_failed") == 1
+
+
+def test_failure_without_fallback_is_an_error_response():
+    def broken_runner(engine, program, machine, request):
+        raise RuntimeError("no dice")
+
+    with AnalysisService(runner=broken_runner) as svc:
+        resp = svc.analyze(_req())  # oracle has no degrade chain
+    assert not resp.ok
+    assert "no dice" in resp.error
+    assert resp.mrc is None
+
+
+# -- serve mode / CLI surface ----------------------------------------
+
+
+def test_serve_jsonl_round_trip(tmp_path, capsys):
+    reqs = tmp_path / "reqs.jsonl"
+    resps = tmp_path / "resps.jsonl"
+    reqs.write_text(
+        "\n".join([
+            json.dumps({"id": "a", "model": "gemm", "n": 16,
+                        "engine": "oracle"}),
+            "",  # blank lines are skipped
+            json.dumps({"id": "dup", "model": "gemm", "n": 16,
+                        "engine": "oracle"}),
+            json.dumps({"id": "bad", "model": "nope"}),
+            json.dumps({"id": "uf", "model": "gemm", "wat": 1}),
+        ]) + "\n"
+    )
+    rc = main([
+        "serve", "--requests", str(reqs), "--responses", str(resps),
+        "--cache-dir", str(tmp_path / "store"),
+    ])
+    assert rc == 0
+    lines = [
+        json.loads(ln) for ln in resps.read_text().splitlines()
+    ]
+    assert [d["id"] for d in lines] == ["a", "dup", None, None]
+    a, dup, bad, uf = lines
+    assert a["ok"] and a["engine_used"] == "oracle"
+    assert a["mrc_lines"][0].startswith("0, ")
+    assert dup["ok"] and dup["fingerprint"] == a["fingerprint"]
+    assert not bad["ok"] and "unknown model" in bad["error"]
+    assert not uf["ok"] and "wat" in uf["error"]
+    # served dumps match the direct CLI acc output byte for byte
+    assert main(["acc", "--model", "gemm", "--n", "16",
+                 "--engine", "oracle"]) == 0
+    direct = capsys.readouterr().out
+    mrc_direct = direct.splitlines()
+    i = mrc_direct.index("miss ratio")
+    assert a["mrc_lines"] == mrc_direct[i + 1:-1]
+
+
+def test_cli_cache_dir_acc_matches_direct(tmp_path, capsys):
+    argv = ["acc", "--model", "gemm", "--n", "16", "--engine", "oracle"]
+    assert main(argv) == 0
+    direct = capsys.readouterr().out
+    cached = argv + ["--cache-dir", str(tmp_path / "store")]
+    assert main(cached) == 0
+    assert capsys.readouterr().out == direct
+    assert main(cached) == 0  # warm: served from the store
+    assert capsys.readouterr().out == direct
+
+
+def test_cli_cache_dir_speed_and_mrc_out(tmp_path, capsys):
+    out = tmp_path / "mrc.txt"
+    assert main([
+        "speed", "--model", "gemm", "--n", "16", "--engine", "oracle",
+        "--reps", "2", "--cache-dir", str(tmp_path / "store"),
+    ]) == 0
+    sout = capsys.readouterr().out
+    assert "run 0" in sout and "cache miss" in sout
+    assert "run 1" in sout and "cache mem" in sout
+    assert main([
+        "acc", "--model", "gemm", "--n", "16", "--engine", "oracle",
+        "--cache-dir", str(tmp_path / "store"),
+        "--mrc-out", str(out),
+    ]) == 0
+    capsys.readouterr()
+    assert out.read_text().splitlines()[0] == "miss ratio"
+
+
+def test_cli_cache_dir_flag_validation():
+    with pytest.raises(SystemExit):
+        main(["acc", "--cache-dir", "/tmp/x", "--engine", "native"])
+    with pytest.raises(SystemExit):
+        main(["sample", "--cache-dir", "/tmp/x", "--r10"])
+    with pytest.raises(SystemExit):
+        main(["trace", "--cache-dir", "/tmp/x"])
+    with pytest.raises(SystemExit):
+        main(["acc", "--deadline-s", "5"])  # needs --cache-dir
+    with pytest.raises(SystemExit):
+        main([])  # mode required unless --list-models
+
+
+def test_cli_list_models(capsys):
+    assert main(["--list-models"]) == 0
+    out = capsys.readouterr().out
+    for name in REGISTRY:
+        assert name in out
+    assert "audited" in out and "probe-backed" in out
+
+
+# -- kernel-cache telemetry (satellite) -------------------------------
+
+
+def test_kernel_cache_counters_route_to_telemetry():
+    from pluss_sampler_optimization_tpu import MachineConfig
+    from pluss_sampler_optimization_tpu.sampler import periodic
+
+    periodic._validate_nest.cache_clear()
+    periodic._compiled_nest.cache_clear()
+    tele = telemetry.enable()
+    prog = REGISTRY["gemm"](16)
+    periodic.run_periodic(prog, MachineConfig())
+    assert tele.counters.get("kernel_cache_misses", 0) >= 1
+    misses = tele.counters["kernel_cache_misses"]
+    periodic.run_periodic(prog, MachineConfig())
+    telemetry.disable()
+    assert tele.counters.get("kernel_cache_hits", 0) >= 1
+    assert tele.counters["kernel_cache_misses"] == misses
+
+
+# -- atomic writes (satellite) ---------------------------------------
+
+
+def test_atomic_writes_leave_no_tmp_and_round_trip(tmp_path):
+    p = tmp_path / "doc.json"
+    atomic_write_json(str(p), {"pi": 0.1 + 0.2, "xs": [1, 2]})
+    assert json.loads(p.read_text()) == {"pi": 0.1 + 0.2,
+                                         "xs": [1, 2]}
+    atomic_write_text(str(p), "plain\n")
+    assert p.read_text() == "plain\n"
+    leftovers = [
+        f for f in os.listdir(tmp_path) if f.endswith(".tmp")
+    ]
+    assert leftovers == []
+
+
+# -- store checker (satellite) ---------------------------------------
+
+
+def test_check_service_store_validates_and_gcs(tmp_path, capsys):
+    store = tmp_path / "store"
+    with AnalysisService(cache_dir=str(store)) as svc:
+        resp = svc.analyze(_req())
+    assert resp.ok
+    assert check_service_store.main([str(store)]) == 0
+    out = capsys.readouterr().out
+    assert "1 valid, 0 corrupt" in out
+
+    # plant a corrupt record, an orphaned tmp, and a stale entry
+    bad = store / "ff" / ("ff" + "0" * 62 + ".json")
+    bad.parent.mkdir(exist_ok=True)
+    bad.write_text("{truncated")
+    (store / "orphan.x.tmp").write_text("half")
+    old_path = store / "ee" / ("ee" + "0" * 62 + ".json")
+    old_path.parent.mkdir(exist_ok=True)
+    old = _fake_record("ee" + "0" * 62)
+    old["created_at"] = time.time() - 10 * 86400
+    old_path.write_text(json.dumps(old))
+
+    assert check_service_store.main(
+        [str(store), "--max-age-days", "1"]
+    ) == 1
+    err = capsys.readouterr().err
+    assert "CORRUPT" in err and "stale" in err and "tmp" in err
+
+    assert check_service_store.main(
+        [str(store), "--max-age-days", "1", "--gc"]
+    ) == 0
+    capsys.readouterr()
+    assert not bad.exists() and not old_path.exists()
+    assert not (store / "orphan.x.tmp").exists()
+    # the store is clean again, and the live record survived
+    assert check_service_store.main([str(store)]) == 0
+    assert "1 valid, 0 corrupt" in capsys.readouterr().out
