@@ -471,6 +471,12 @@ def main() -> int:
                     "hang (round 2 saw a compile service die 25 min "
                     "in) is still bounded by this flag "
                     "(0 = no warm-up watchdog)")
+    ap.add_argument("--ledger", default="LEDGER.jsonl",
+                    help="append this run's headline row (and the MRC "
+                    "digest) to the run ledger at this path, relative "
+                    "to the script directory; the evidence JSON "
+                    "cross-references it and `cli stats` / "
+                    "tools/check_ledger.py consume it ('' disables)")
     ap.add_argument("--extras-spent", type=float, default=0.0,
                     help=argparse.SUPPRESS)  # internal: wall seconds
     # already burned by a predecessor process before an accel-hang
@@ -839,6 +845,13 @@ def main() -> int:
             out["mrc_l1_err"] = round(
                 mrc_l1_error(mrc_engine, mrc_cache[(model, n)]), 6
             )
+            # the run ledger keys accuracy on this digest; identical
+            # engine output digests identically across rounds
+            from pluss_sampler_optimization_tpu.runtime.obs import (
+                ledger as obs_ledger,
+            )
+
+            out["mrc_digest"] = obs_ledger.mrc_digest(mrc_engine)
             return t_cpp / engine_s
         except RuntimeError as e:  # no toolchain: throughput only
             out["baseline_error"] = str(e)
@@ -1052,6 +1065,40 @@ def main() -> int:
         )
 
     metric = f"{args.model}{args.n}_{args.engine}_throughput"
+
+    # run-ledger row: the longitudinal record across BENCH_r*.json
+    # rounds — headline value, latency, and the MRC digest, appended
+    # BEFORE emit_result so the evidence JSON can cross-reference the
+    # ledger path (and a ledger failure never sinks the headline)
+    if args.ledger:
+        ledger_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), args.ledger
+        )
+        try:
+            from pluss_sampler_optimization_tpu.runtime.obs import (
+                ledger as obs_ledger,
+            )
+
+            obs_ledger.append(ledger_path, {
+                "kind": "bench",
+                "source": "bench",
+                "ok": True,
+                "metric": metric,
+                "value": round(work / t_tpu, 1),
+                "unit": f"{unit_name}/s/chip",
+                "vs_baseline": round(vs_baseline, 2),
+                "engine": args.engine,
+                "model": args.model,
+                "n": args.n,
+                "latency_s": round(t_tpu, 6),
+                "device": str(dev.platform),
+                "mrc_l1_err": extra.get("mrc_l1_err"),
+                "mrc_digest": extra.get("mrc_digest"),
+            })
+            extra["ledger"] = args.ledger
+        except Exception as e:
+            extra["ledger_error"] = repr(e)
+
     # full telemetry record (span tree, counters, jax monitoring delta,
     # device/host metrics) as a stamped sidecar next to the evidence
     # files; the evidence JSON names it so the two cross-reference

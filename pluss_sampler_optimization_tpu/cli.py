@@ -167,7 +167,8 @@ def _run_engine(engine: str, program, machine, args):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
     ap.add_argument("mode", nargs="?",
-                    choices=["acc", "speed", "sample", "trace", "serve"])
+                    choices=["acc", "speed", "sample", "trace",
+                             "serve", "stats"])
     ap.add_argument("--list-models", action="store_true",
                     help="print the model registry (nest/ref geometry "
                     "+ exact-router analytic audit status, from "
@@ -287,6 +288,37 @@ def main(argv=None) -> int:
         "TensorBoard). Independent of --telemetry-out.",
     )
     ap.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="PATH",
+        help="export this run's telemetry span tree as Chrome "
+        "trace_event JSON at PATH — load it in Perfetto "
+        "(ui.perfetto.dev) or chrome://tracing. Span nesting and "
+        "device-sync timings are preserved; works in every mode "
+        "(README \"Observability\").",
+    )
+    ap.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="PATH",
+        help="export this run's telemetry counters/gauges as "
+        "Prometheus text exposition at PATH (counters as *_total, "
+        "plus the run duration) — suits the node-exporter textfile "
+        "collector. Works in every mode.",
+    )
+    ap.add_argument(
+        "--ledger",
+        default=None,
+        metavar="PATH",
+        help="append one row per engine/service execution to this "
+        "JSONL run ledger (schema-versioned; fingerprint, engine, "
+        "latency, cache tier, degradation chain, compile deltas, MRC "
+        "digest). acc/speed/sample append directly (or via the "
+        "service under --cache-dir), serve appends per request; "
+        "`stats` mode aggregates a ledger and "
+        "tools/check_ledger.py validates/GCs it.",
+    )
+    ap.add_argument(
         "--cache-dir",
         default=None,
         metavar="DIR",
@@ -333,7 +365,11 @@ def main(argv=None) -> int:
     if args.list_models:
         return _list_models()
     if args.mode is None:
-        ap.error("mode is required (acc|speed|sample|trace|serve)")
+        ap.error("mode is required (acc|speed|sample|trace|serve|"
+                 "stats)")
+
+    if args.mode == "stats":
+        return _stats(args)
 
     if args.platform:
         import jax
@@ -386,6 +422,11 @@ def main(argv=None) -> int:
                 f"(have {', '.join(_ENGINES)})"
             )
 
+    if args.ledger and args.mode == "trace":
+        raise SystemExit(
+            "--ledger records engine/service executions (acc|speed|"
+            "sample|serve|stats); trace mode has none"
+        )
     if args.cache_dir:
         if args.mode == "trace":
             raise SystemExit(
@@ -428,9 +469,12 @@ def main(argv=None) -> int:
 
 def _observed(args, fn) -> int:
     """Run fn() under the observability flags (--telemetry-out /
-    --profile-dir) — shared by the mode executor and serve mode."""
+    --trace-out / --metrics-out / --profile-dir) — shared by the mode
+    executor and serve mode. The exporters read the SAME stopped run,
+    so the Chrome trace's span tree is exactly `Telemetry.to_json`'s.
+    """
     tele = None
-    if args.telemetry_out:
+    if args.telemetry_out or args.trace_out or args.metrics_out:
         from .runtime import telemetry
 
         tele = telemetry.enable()
@@ -444,10 +488,43 @@ def _observed(args, fn) -> int:
     finally:
         if tele is not None:
             from .runtime import telemetry
+            from .runtime.obs import exporters
 
             telemetry.disable()
-            tele.print_summary()
-            tele.write_json(args.telemetry_out)
+            if args.telemetry_out:
+                tele.print_summary()
+                tele.write_json(args.telemetry_out)
+            if args.trace_out or args.metrics_out:
+                doc = tele.to_json()
+                if args.trace_out:
+                    exporters.write_chrome_trace(args.trace_out, doc)
+                if args.metrics_out:
+                    exporters.write_prometheus(args.metrics_out, doc)
+
+
+def _stats(args) -> int:
+    """`stats` mode: aggregate a run ledger into the per-engine
+    serving picture (p50/p95 latency, cache hit rates, degradation
+    counts, drift status)."""
+    from .runtime.obs import ledger as obs_ledger
+
+    if not args.ledger:
+        raise SystemExit("stats mode needs --ledger PATH")
+    try:
+        entries = list(obs_ledger.iter_rows(args.ledger))
+    except OSError as e:
+        raise SystemExit(f"cannot read ledger: {e}")
+    rows = [row for _ln, row, _err in entries if row is not None]
+    bad = [(ln, err) for ln, row, err in entries if row is None]
+    for line in obs_ledger.format_stats(obs_ledger.aggregate(rows)):
+        print(line)
+    if bad:
+        print(
+            f"warning: {len(bad)} invalid line(s) skipped (run "
+            "tools/check_ledger.py for details)",
+            file=sys.stderr,
+        )
+    return 0
 
 
 def _request_from_args(args, engine):
@@ -472,7 +549,8 @@ def _serve(args) -> int:
     )
     try:
         with AnalysisService(
-            cache_dir=args.cache_dir, max_workers=args.max_workers
+            cache_dir=args.cache_dir, max_workers=args.max_workers,
+            ledger_path=args.ledger,
         ) as svc:
             failures = serve_jsonl(svc, fin, fout)
     finally:
@@ -496,7 +574,9 @@ def _execute_via_service(args, machine, program, engine) -> int:
     from .service import AnalysisService
 
     request = _request_from_args(args, engine)
-    with AnalysisService(cache_dir=args.cache_dir) as svc:
+    with AnalysisService(
+        cache_dir=args.cache_dir, ledger_path=args.ledger
+    ) as svc:
         if args.mode == "speed":
             times = []
             for rep in range(args.reps):
@@ -532,15 +612,73 @@ def _execute_via_service(args, machine, program, engine) -> int:
     return 0
 
 
+def _cli_ledger_row(args, program, engine, engine_used, latency_s,
+                    mrc=None, compiles0=None, reps=None) -> None:
+    """One direct-path (no service) execution -> run-ledger row.
+
+    Shares the service's content address when the engine is
+    service-addressable, so direct and served executions of the same
+    request join on one fingerprint in the aggregated ledger."""
+    from .runtime import telemetry
+    from .runtime.obs import ledger as obs_ledger
+    from .service.executor import SERVICE_ENGINES
+
+    fp = None
+    if engine in SERVICE_ENGINES:
+        try:
+            fp = _request_from_args(args, engine).fingerprint(program)
+        except Exception:
+            pass
+    row = {
+        "kind": "request",
+        "source": "cli",
+        "ok": True,
+        "fingerprint": fp,
+        "engine_requested": engine,
+        "engine_used": engine_used,
+        "model": args.model,
+        "n": args.n,
+        "latency_s": round(latency_s, 6),
+        "cache": None,
+        "degraded": [],
+        "mrc_digest": (
+            obs_ledger.mrc_digest(mrc) if mrc is not None else None
+        ),
+    }
+    if compiles0 is not None:
+        now = telemetry.compile_counters_snapshot()
+        row["compile_delta"] = {
+            k: round(v - compiles0.get(k, 0), 4)
+            if isinstance(v, float) else v - compiles0.get(k, 0)
+            for k, v in now.items() if v - compiles0.get(k, 0)
+        }
+    if reps is not None:
+        row["reps"] = reps
+    obs_ledger.append(args.ledger, row)
+
+
 def _execute(args, machine, program, engine) -> int:
     """Run the selected mode (spans/counters land in the active
     telemetry run, if any — main() owns enable/export)."""
+    import time
+
     from .runtime import report
     from .runtime.aet import aet_mrc
     from .runtime.cri import cri_distribute
 
     if args.cache_dir and args.mode in ("acc", "speed", "sample"):
         return _execute_via_service(args, machine, program, engine)
+
+    compiles0 = None
+    if args.ledger:
+        from .runtime import telemetry as _telemetry
+
+        # compile-counter deltas need the process-global listeners
+        try:
+            _telemetry.register_jax_hooks()
+        except Exception:
+            pass
+        compiles0 = _telemetry.compile_counters_snapshot()
 
     if args.mode == "trace":
         # the reference's -DDEBUG access/reuse logs (runtime/debug.py)
@@ -571,11 +709,18 @@ def _execute(args, machine, program, engine) -> int:
         from .runtime import telemetry
         from .runtime.timing import timed
 
-        times, _, flushes = timed(
+        times, last, flushes = timed(
             lambda: _run_engine(engine, program, machine, args),
             reps=args.reps,
             flush_kb=machine.cache_kb,
         )
+        if args.ledger:
+            _cli_ledger_row(
+                args, program, engine,
+                getattr(last[0], "engine", None) or engine,
+                sorted(times)[len(times) // 2],
+                compiles0=compiles0, reps=args.reps,
+            )
         for rep, dt in enumerate(times):
             print(f"{engine} {program.name} run {rep}: {dt:.6f} s")
         print(
@@ -596,6 +741,11 @@ def _execute(args, machine, program, engine) -> int:
         return 0
 
     def result_lines(eng: str):
+        t0 = time.perf_counter()
+        if args.ledger:
+            from .runtime import telemetry as _t
+
+            run_compiles0 = _t.compile_counters_snapshot()
         res, per_ref = _run_engine(eng, program, machine, args)
         lines: list[str] = []
         if args.mode == "sample" and per_ref is not None:
@@ -623,6 +773,15 @@ def _execute(args, machine, program, engine) -> int:
         lines += report.mrc_lines(mrc)
         label = "samples" if per_ref is not None else "accesses"
         lines.append(f"max iteration count: {res.total_accesses} {label}")
+        if args.ledger:
+            # one row per engine execution — the --diff-against second
+            # engine gets its own row too
+            _cli_ledger_row(
+                args, program, eng,
+                getattr(res, "engine", None) or eng,
+                time.perf_counter() - t0, mrc=mrc,
+                compiles0=run_compiles0,
+            )
         return lines, mrc
 
     lines, mrc = result_lines(engine)

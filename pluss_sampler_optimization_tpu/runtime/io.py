@@ -56,3 +56,31 @@ def atomic_write_json(path: str, obj, indent: int | None = 1) -> None:
     contract depends on this.
     """
     atomic_write_text(path, json.dumps(obj, indent=indent) + "\n")
+
+
+def append_text_line(path: str, line: str) -> None:
+    """Append one newline-terminated line durably (O_APPEND + fsync).
+
+    The append-only consumers (the run ledger, runtime/obs/ledger.py)
+    need the complement of atomic_write_text: many writers growing ONE
+    file. A single os.write under O_APPEND is atomic with respect to
+    concurrent appenders on POSIX local filesystems — two processes'
+    rows never interleave — and a crash mid-write can at worst leave
+    one truncated line at the tail, which every ledger reader already
+    skips as invalid.
+    """
+    if not line.endswith("\n"):
+        line += "\n"
+    if "\n" in line[:-1]:
+        raise ValueError("append_text_line takes exactly one line")
+    data = line.encode()
+    fd = os.open(
+        os.fspath(path),
+        os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+        0o644,
+    )
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)

@@ -1,0 +1,30 @@
+"""Observability subsystem: run ledger, exporters, drift monitoring.
+
+Three longitudinal layers over the per-run telemetry in
+runtime/telemetry.py (which only ever describes ONE run and vanishes
+once its JSON is written):
+
+- **ledger** — an append-only, schema-versioned JSONL ledger with one
+  row per engine/service execution (fingerprint, engine, latency,
+  cache disposition, degradation chain, compile-counter deltas, MRC
+  digest), written from the service executor, the CLI modes, bench.py,
+  and the drift monitor; validated/GC'd by tools/check_ledger.py and
+  aggregated by the CLI `stats` mode.
+- **exporters** — the Telemetry span tree as Chrome `trace_event` JSON
+  (Perfetto / chrome://tracing) and the counters/gauges as Prometheus
+  text exposition, behind the CLI `--trace-out` / `--metrics-out`
+  flags (also importable as `telemetry.exporters`).
+- **drift** — small-config sampled-vs-exact MRC audits (max/mean
+  absolute miss-ratio delta) appended to the ledger and gated by
+  tools/check_drift.py, so the executor's silent exact→sampled
+  degradation has a continuously watched accuracy bound.
+
+Everything here is observation only: with no ledger path and no export
+flag nothing in this package executes, and engine results are pinned
+bit-identical with observability enabled vs disabled
+(tests/test_obs.py).
+"""
+
+from . import drift, exporters, ledger
+
+__all__ = ["drift", "exporters", "ledger"]

@@ -1,0 +1,149 @@
+"""Accuracy-drift monitor: sampled-vs-exact MRC audits.
+
+PLUSS's value is a *model* — sampled MRCs standing in for exact
+locality analysis — and the service executor even degrades
+exact→sampled silently under deadline pressure. Nothing watched the
+model-quality side until now: this module runs small-config audits
+pitting the sampled engine against the exact router (the same
+engines the service dispatches), computes MRC error metrics over the
+curve, appends them to the run ledger, and flags threshold breaches
+as telemetry events. tools/check_drift.py is the CI gate (nonzero
+exit on breach), exercised from tier-1.
+
+Metrics: `max_abs_delta` (worst-case miss-ratio error at any cache
+size) and `mean_abs_delta` (average over the common support). The
+default thresholds are calibrated against the measured seed-0 CPU
+values at the default audit configs (gemm/mvt n=48, ratio 0.3:
+max_abs ≈ 0.135 / 0.050) with ~2.5x headroom, so the gate trips on a
+real sampler regression, not on the known sampling noise floor.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import telemetry
+from . import ledger as obs_ledger
+
+# Gate thresholds for one audit; see module docstring for calibration.
+DRIFT_THRESHOLDS = {
+    "max_abs_delta": 0.35,
+    "mean_abs_delta": 0.05,
+}
+
+# Default audit matrix for tools/check_drift.py: gemm (the reference's
+# anchor model) plus mvt (a non-gemm family with a different curve
+# shape) — small enough that the pair audits in seconds on CPU.
+DEFAULT_AUDIT_MODELS = ("gemm", "mvt")
+DEFAULT_AUDIT_N = 48
+DEFAULT_AUDIT_RATIO = 0.3
+
+
+def mrc_drift_metrics(mrc_exact, mrc_sampled) -> dict:
+    """Max/mean absolute miss-ratio delta over the common support.
+
+    The curves may differ in length (the sampled histogram's support
+    can be smaller); the comparison runs over the common prefix — the
+    same convention as runtime/aet.py::mrc_l1_error — and both lengths
+    are recorded so a support collapse is itself visible.
+    """
+    import numpy as np
+
+    a = np.asarray(mrc_exact, dtype=np.float64)
+    b = np.asarray(mrc_sampled, dtype=np.float64)
+    m = min(len(a), len(b))
+    if m == 0:
+        return {
+            "max_abs_delta": 1.0, "mean_abs_delta": 1.0,
+            "support": 0, "len_exact": len(a), "len_sampled": len(b),
+        }
+    d = np.abs(a[:m] - b[:m])
+    return {
+        "max_abs_delta": round(float(d.max()), 6),
+        "mean_abs_delta": round(float(d.mean()), 6),
+        "support": m,
+        "len_exact": int(len(a)),
+        "len_sampled": int(len(b)),
+    }
+
+
+def drift_audit(
+    model: str,
+    n: int = DEFAULT_AUDIT_N,
+    ratio: float = DEFAULT_AUDIT_RATIO,
+    seed: int = 0,
+    machine=None,
+    thresholds: dict | None = None,
+    ledger_path: str | None = None,
+    source: str = "drift",
+) -> dict:
+    """One sampled-vs-exact audit -> the ledger "drift" row (appended
+    to `ledger_path` when given, returned either way).
+
+    Reuses the production engines end to end: the exact side goes
+    through the exact router (sampler/periodic.py::run_exact — the
+    periodic/analytic/dense auto-route), the sampled side through
+    run_sampled with a deterministic seed, and both fold through the
+    same CRI + AET pipeline the service serves. A threshold breach is
+    recorded in the row (`breach`, `ok`), counted
+    (`drift_breach` telemetry counter) and emitted as a `drift_breach`
+    telemetry event; tools/check_drift.py turns it into a nonzero
+    exit.
+    """
+    from ...config import MachineConfig, SamplerConfig
+    from ...models import build as build_model
+    from ..aet import aet_mrc
+    from ..cri import cri_distribute
+
+    machine = machine if machine is not None else MachineConfig()
+    thresholds = dict(thresholds or DRIFT_THRESHOLDS)
+    program = build_model(model, n)
+    T = machine.thread_num
+
+    t0 = time.perf_counter()
+    with telemetry.span("drift_audit", model=model, n=n):
+        from ...sampler.periodic import run_exact
+        from ...sampler.sampled import run_sampled
+
+        with telemetry.span("drift_exact"):
+            exact = run_exact(program, machine)
+            mrc_exact = aet_mrc(
+                cri_distribute(exact.state, T, T), machine
+            )
+        with telemetry.span("drift_sampled"):
+            state, results = run_sampled(
+                program, machine,
+                SamplerConfig(ratio=ratio, seed=seed),
+            )
+            mrc_sampled = aet_mrc(cri_distribute(state, T, T), machine)
+    metrics = mrc_drift_metrics(mrc_exact, mrc_sampled)
+    breach = any(
+        metrics[key] > limit for key, limit in thresholds.items()
+    )
+    row = {
+        "kind": "drift",
+        "source": source,
+        "ok": not breach,
+        "breach": breach,
+        "model": model,
+        "n": n,
+        "ratio": ratio,
+        "seed": seed,
+        "engine_exact": getattr(exact, "engine", "exact"),
+        "samples": int(sum(r.n_samples for r in results)),
+        "latency_s": round(time.perf_counter() - t0, 6),
+        "thresholds": thresholds,
+        "mrc_digest_exact": obs_ledger.mrc_digest(mrc_exact),
+        "mrc_digest_sampled": obs_ledger.mrc_digest(mrc_sampled),
+        **metrics,
+    }
+    if breach:
+        telemetry.count("drift_breach")
+        telemetry.event(
+            "drift_breach", model=model, n=n,
+            max_abs_delta=metrics["max_abs_delta"],
+            mean_abs_delta=metrics["mean_abs_delta"],
+        )
+    if ledger_path:
+        row = obs_ledger.append(ledger_path, row)
+    return row

@@ -1,0 +1,318 @@
+"""Append-only run ledger: one JSONL row per engine/service execution.
+
+Every run of the engines/service used to vanish once its per-run
+telemetry JSON was written — no history, no regression signal across
+runs. The ledger is the longitudinal record: the service executor, the
+CLI acc/speed/sample modes, bench.py, and the drift monitor each
+append one row per execution, all into one file, so a single artifact
+answers "what ran, how fast, from which cache tier, degraded how, and
+did the MRC change".
+
+Row contract (LEDGER_VERSION, enforced by `validate_row` — the single
+source of truth shared with tools/check_ledger.py, the same pattern as
+service/cache.py::validate_record):
+
+- every row: `ledger_version`, `ts` (unix seconds), `kind`
+  ("request" | "drift" | "bench"), `source` (who wrote it), `ok`;
+- kind "request" (service executor / CLI modes): `engine_requested`,
+  `engine_used`, `model`, `n`, `latency_s`, `cache` disposition
+  (null = direct run, "miss" = cold, "mem"/"disk" = warm tiers),
+  `degraded` chain ([{from, to, reason}]), optional `fingerprint`
+  (the service content address — CLI rows carry it too when the
+  engine is service-addressable, so direct and served executions of
+  the same request join on one key), optional `compile_delta`
+  (nonzero jax compile-counter movement during the execution) and
+  `mrc_digest`;
+- kind "drift" (runtime/obs/drift.py): the sampled-vs-exact MRC error
+  metrics (`max_abs_delta` / `mean_abs_delta`) and the `breach` flag;
+- kind "bench" (bench.py): the headline `metric`/`value` plus the same
+  mrc_digest/latency fields.
+
+Appends are durable single-write O_APPEND lines
+(runtime/io.py::append_text_line): concurrent writers never interleave
+and a crash leaves at most one truncated tail line, which every reader
+here skips (and tools/check_ledger.py --gc removes). Rows are
+validated BEFORE hitting the file — a writer bug fails loudly at the
+call site, never poisons the ledger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from ..io import append_text_line
+
+LEDGER_VERSION = 1
+
+KINDS = ("request", "drift", "bench")
+
+# cache dispositions a request row may carry: None = direct engine run
+# (no store in the path), "miss" = cold service execution, "mem" /
+# "disk" = warm service tiers
+CACHE_TIERS = (None, "miss", "mem", "disk")
+
+_NUM = (int, float)
+
+
+def mrc_digest(mrc) -> str:
+    """16-hex digest of an MRC's float64 bytes.
+
+    Bit-identical curves (the warm-repeat / exact-engine contract)
+    digest identically; any numeric drift changes the digest. Used to
+    make degraded or drifted responses attributable in the ledger
+    without storing the (up to 327k-entry) curve itself.
+    """
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(mrc, dtype=np.float64))
+    return hashlib.sha256(a.tobytes()).hexdigest()[:16]
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def validate_row(row) -> list[str]:
+    """All schema violations of one parsed ledger row (empty = valid).
+
+    Single source of truth for `append` (validate-before-write) AND
+    the offline checker (tools/check_ledger.py). Unknown extra keys
+    are allowed — rows may carry source-specific context (reps,
+    thresholds, evidence pointers) without a version bump.
+    """
+    errors: list[str] = []
+    if not isinstance(row, dict):
+        return ["row is not a JSON object"]
+    if row.get("ledger_version") != LEDGER_VERSION:
+        errors.append(
+            f"ledger_version must be {LEDGER_VERSION}, got "
+            f"{row.get('ledger_version')!r}"
+        )
+    if not _is_num(row.get("ts")) or row.get("ts", -1) < 0:
+        errors.append("'ts' must be a non-negative number")
+    kind = row.get("kind")
+    if kind not in KINDS:
+        errors.append(f"'kind' must be one of {KINDS}, got {kind!r}")
+    if not isinstance(row.get("source"), str) or not row.get("source"):
+        errors.append("'source' must be a non-empty string")
+    if not isinstance(row.get("ok"), bool):
+        errors.append("'ok' must be a boolean")
+
+    def need_str(key, nullable=False):
+        v = row.get(key)
+        if v is None and nullable:
+            return
+        if not isinstance(v, str):
+            errors.append(f"'{key}' must be a string"
+                          + (" or null" if nullable else ""))
+
+    def need_num(key, nullable=False):
+        v = row.get(key)
+        if v is None and nullable:
+            return
+        if not _is_num(v):
+            errors.append(f"'{key}' must be a number"
+                          + (" or null" if nullable else ""))
+
+    if kind == "request":
+        need_str("engine_requested")
+        need_str("engine_used", nullable=True)
+        need_str("model")
+        need_num("n")
+        need_num("latency_s", nullable=True)
+        if row.get("cache") not in CACHE_TIERS:
+            errors.append(
+                f"'cache' must be one of {CACHE_TIERS}, got "
+                f"{row.get('cache')!r}"
+            )
+        if not isinstance(row.get("degraded"), list):
+            errors.append("'degraded' must be a list")
+        need_str("fingerprint", nullable=True)
+        need_str("mrc_digest", nullable=True)
+        if "compile_delta" in row and not isinstance(
+            row["compile_delta"], dict
+        ):
+            errors.append("'compile_delta' must be an object")
+    elif kind == "drift":
+        need_str("model")
+        need_num("n")
+        need_num("max_abs_delta")
+        need_num("mean_abs_delta")
+        if not isinstance(row.get("breach"), bool):
+            errors.append("'breach' must be a boolean")
+    elif kind == "bench":
+        need_str("metric")
+        need_num("value")
+    return errors
+
+
+def append(path: str, row: dict) -> dict:
+    """Stamp, validate, and durably append one row; returns the row.
+
+    Stamps `ledger_version` and `ts` when absent. Raises ValueError on
+    an invalid row — writers that must never fail a request wrap this
+    (service/executor.py counts `service_ledger_write_failed`).
+    """
+    row = dict(row)
+    row.setdefault("ledger_version", LEDGER_VERSION)
+    row.setdefault("ts", round(time.time(), 3))
+    errors = validate_row(row)
+    if errors:
+        raise ValueError(
+            "invalid ledger row: " + "; ".join(errors)
+        )
+    append_text_line(
+        path, json.dumps(row, sort_keys=True, separators=(",", ":"))
+    )
+    return row
+
+
+def iter_rows(path: str):
+    """Yield (line_no, row | None, error | None) per non-blank line.
+
+    Unparseable or schema-invalid lines come back with row=None and
+    the reason — readers decide whether to skip (stats) or report
+    (the checker). Never raises on content, only on an unreadable
+    file.
+    """
+    with open(path) as f:
+        for line_no, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                yield line_no, None, f"invalid JSON: {e}"
+                continue
+            errors = validate_row(row)
+            if errors:
+                yield line_no, None, "; ".join(errors)
+                continue
+            yield line_no, row, None
+
+
+def read_rows(path: str) -> list[dict]:
+    """All valid rows, in file order (invalid lines skipped)."""
+    return [row for _ln, row, _err in iter_rows(path) if row is not None]
+
+
+def tail(path: str, n: int = 5) -> list[dict]:
+    """The last n valid rows (empty list for a missing ledger)."""
+    try:
+        rows = read_rows(path)
+    except OSError:
+        return []
+    return rows[-n:] if n > 0 else []
+
+
+# -- aggregation (the CLI `stats` mode) --------------------------------
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def aggregate(rows: list[dict]) -> dict:
+    """Roll a ledger up into the per-engine serving picture: request
+    counts, p50/p95 latency, cache-tier hit rates, degradation and
+    failure counts, plus the latest drift metrics per (model, n) and
+    the bench row count."""
+    requests: dict = {}
+    drift: dict = {}
+    bench = 0
+    by_kind: dict = {}
+    for row in rows:
+        kind = row["kind"]
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        if kind == "request":
+            eng = row["engine_requested"]
+            agg = requests.setdefault(eng, {
+                "count": 0, "ok": 0, "failed": 0, "degraded": 0,
+                "latencies": [],
+                "cache": {"mem": 0, "disk": 0, "miss": 0, "direct": 0},
+            })
+            agg["count"] += 1
+            if row["ok"]:
+                agg["ok"] += 1
+            else:
+                agg["failed"] += 1
+            if row.get("degraded"):
+                agg["degraded"] += 1
+            if row.get("latency_s") is not None:
+                agg["latencies"].append(float(row["latency_s"]))
+            tier = row.get("cache")
+            agg["cache"][tier if tier else "direct"] += 1
+        elif kind == "drift":
+            # latest row wins per (model, n): the monitor's current view
+            drift[(row["model"], row["n"])] = row
+        elif kind == "bench":
+            bench += 1
+    for agg in requests.values():
+        lats = sorted(agg.pop("latencies"))
+        agg["p50_latency_s"] = round(_percentile(lats, 0.50), 6)
+        agg["p95_latency_s"] = round(_percentile(lats, 0.95), 6)
+        warm = agg["cache"]["mem"] + agg["cache"]["disk"]
+        served = warm + agg["cache"]["miss"]
+        agg["cache_hit_rate"] = (
+            round(warm / served, 3) if served else None
+        )
+    return {
+        "rows": len(rows),
+        "by_kind": by_kind,
+        "requests": requests,
+        "drift": [
+            drift[k] for k in sorted(drift, key=lambda k: (k[0], k[1]))
+        ],
+        "bench_rows": bench,
+    }
+
+
+def format_stats(agg: dict) -> list[str]:
+    """The aggregate as the CLI `stats` mode's printed table."""
+    lines = [
+        "ledger: %d rows (%s)" % (
+            agg["rows"],
+            ", ".join(f"{k}={v}"
+                      for k, v in sorted(agg["by_kind"].items()))
+            or "empty",
+        )
+    ]
+    if agg["requests"]:
+        lines.append(
+            f"{'engine':<10} {'reqs':>5} {'ok':>4} {'fail':>4} "
+            f"{'p50_s':>9} {'p95_s':>9} {'mem':>4} {'disk':>4} "
+            f"{'miss':>4} {'dir':>4} {'hit%':>5} {'degr':>4}"
+        )
+        for eng in sorted(agg["requests"]):
+            a = agg["requests"][eng]
+            c = a["cache"]
+            hit = (
+                f"{a['cache_hit_rate'] * 100:.0f}"
+                if a["cache_hit_rate"] is not None else "-"
+            )
+            lines.append(
+                f"{eng:<10} {a['count']:>5} {a['ok']:>4} "
+                f"{a['failed']:>4} {a['p50_latency_s']:>9.4f} "
+                f"{a['p95_latency_s']:>9.4f} {c['mem']:>4} "
+                f"{c['disk']:>4} {c['miss']:>4} {c['direct']:>4} "
+                f"{hit:>5} {a['degraded']:>4}"
+            )
+    for row in agg["drift"]:
+        lines.append(
+            "drift %s n=%d: max_abs=%.4f mean_abs=%.5f %s" % (
+                row["model"], row["n"], row["max_abs_delta"],
+                row["mean_abs_delta"],
+                "BREACH" if row["breach"] else "ok",
+            )
+        )
+    if agg["bench_rows"]:
+        lines.append(f"bench rows: {agg['bench_rows']}")
+    return lines

@@ -375,13 +375,17 @@ def record_fetch(host_tree):
 
 def counted_lru_cache(maxsize: int = 128,
                       counter: str = "kernel_cache"):
-    """functools.lru_cache with telemetry hit/miss counters.
+    """functools.lru_cache with telemetry hit/miss counters and an
+    occupancy gauge.
 
     Drop-in for the kernel caches scattered across the engines
     (stream/draw/periodic/dense/sharded program-kernel caches): every
     lookup lands in `<counter>_hits` / `<counter>_misses` of the
     active run, so a telemetry export shows compiled-kernel reuse next
-    to the result-cache counters the service records. `cache_clear` /
+    to the result-cache counters the service records, and the
+    `<counter>_size` / `<counter>_maxsize` gauges expose current
+    occupancy vs capacity (cache pressure is visible in the
+    Prometheus export before evictions start). `cache_clear` /
     `cache_info` pass through (tests clear these caches directly).
     The hit/miss attribution reads cache_info around the call — exact
     single-threaded; under concurrent lookups a race can misattribute
@@ -397,10 +401,13 @@ def counted_lru_cache(maxsize: int = 128,
                 return cached(*args, **kwargs)
             before = cached.cache_info().hits
             out = cached(*args, **kwargs)
-            if cached.cache_info().hits > before:
+            info = cached.cache_info()
+            if info.hits > before:
                 count(counter + "_hits")
             else:
                 count(counter + "_misses")
+            gauge(counter + "_size", info.currsize)
+            gauge(counter + "_maxsize", info.maxsize)
             return out
 
         wrapper.cache_clear = cached.cache_clear
@@ -423,6 +430,20 @@ def warn_once(key, message: str, **data) -> None:
         return
     _warned_once.add(key)
     print(message, file=sys.stderr)
+
+
+def __getattr__(name: str):
+    """`telemetry.exporters` resolves to runtime/obs/exporters.py —
+    the exporters live in the obs package (they pull in the ledger's
+    neighbors), but callers reach them through the telemetry module
+    they export. Lazy so the disabled-telemetry import stays light."""
+    if name == "exporters":
+        from .obs import exporters
+
+        return exporters
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
 
 
 # -- jax.monitoring capture -------------------------------------------

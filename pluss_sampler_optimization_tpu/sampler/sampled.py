@@ -418,6 +418,9 @@ def lru_cached(cache: "_collections.OrderedDict", key, build, maxsize: int):
     else:
         telemetry.count("kernel_cache_hits")
         cache.move_to_end(key)
+    # occupancy gauge, same names the counted functools caches export
+    telemetry.gauge("kernel_cache_size", len(cache))
+    telemetry.gauge("kernel_cache_maxsize", maxsize)
     return entry
 
 

@@ -17,8 +17,20 @@ request in input order. Request schema (README "Serving"):
      "threads": 4, "chunk": 4, "ratio": 0.1, "seed": 0,
      "deadline_s": 30.0}
 
-Every field except `model` has a default; unknown fields are an error
-response for that line, never a crash of the batch.
+Every field except `model` has a default; a malformed line — invalid
+JSON, unknown fields, a bad model — is a structured error response
+for that line (with the request `id` echoed whenever the line parsed
+far enough to carry one), never a crash of the batch.
+
+Two introspection request types ride the same protocol:
+
+    {"id": "h1", "type": "healthz"}   -> liveness + engine roster
+    {"id": "s1", "type": "stats"}     -> executor queue depth /
+        in-flight / coalesce counters, cache tier stats, ledger tail
+
+Both answer from the service's instance-local counters (no telemetry
+run required) with the snapshot taken at the moment the line is READ
+— a mid-batch `stats` line observes the requests submitted before it.
 """
 
 from __future__ import annotations
@@ -135,6 +147,7 @@ class AnalysisResponse:
     total_accesses: int | None
     access_label: str | None
     mrc: "np.ndarray | None"
+    mrc_digest: str | None  # 16-hex digest of the MRC (ledger key)
     rih: dict | None  # int key -> count
     dump_lines: list | None
     per_ref_lines: list | None
@@ -161,6 +174,10 @@ class AnalysisResponse:
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
+        if self.mrc_digest is not None:
+            # ties the wire response to its ledger row: a degraded
+            # response's digest is attributable after the fact
+            d["mrc_digest"] = self.mrc_digest
         if self.error is not None:
             d["error"] = self.error
         return d
@@ -177,7 +194,8 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             degraded=outcome.get("degraded") or [],
             latency_s=outcome.get("latency_s"),
             total_accesses=None, access_label=None, mrc=None,
-            rih=None, dump_lines=None, per_ref_lines=None,
+            mrc_digest=None, rih=None, dump_lines=None,
+            per_ref_lines=None,
             error=outcome.get("error") or "execution failed",
         )
     return AnalysisResponse(
@@ -192,6 +210,7 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         total_accesses=record["total_accesses"],
         access_label=record["access_label"],
         mrc=np.asarray(record["mrc"], dtype=np.float64),
+        mrc_digest=outcome.get("mrc_digest"),
         rih={int(k): v for k, v in record["rih"].items()},
         dump_lines=list(record["dump_lines"]),
         per_ref_lines=list(record.get("per_ref_lines", [])) or None,
@@ -200,15 +219,51 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
 
 
 class AnalysisService:
-    """submit()/result() over the cache + executor pair."""
+    """submit()/result() over the cache + executor pair, plus the
+    healthz/stats introspection the serve protocol exposes."""
 
     def __init__(self, cache_dir: str | None = None,
                  max_workers: int = 4, mem_entries: int = 128,
-                 runner=default_runner):
+                 runner=default_runner,
+                 ledger_path: str | None = None):
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
+        self.ledger_path = ledger_path
         self.executor = RequestExecutor(
-            self.cache, max_workers=max_workers, runner=runner
+            self.cache, max_workers=max_workers, runner=runner,
+            ledger_path=ledger_path,
         )
+
+    def healthz(self) -> dict:
+        """Liveness + capability roster (the `healthz` request type).
+        """
+        from .executor import SERVICE_ENGINES
+        from .cache import STORE_VERSION
+
+        ex = self.executor.stats()
+        return {
+            "status": "ok",
+            "engines": list(SERVICE_ENGINES),
+            "store_version": STORE_VERSION,
+            "in_flight": ex["in_flight"],
+            "queue_depth": ex["queue_depth"],
+            "ledger": self.ledger_path,
+        }
+
+    def stats(self, ledger_tail: int = 5) -> dict:
+        """Full introspection snapshot (the `stats` request type):
+        executor queue/coalesce/degradation counters, cache tier
+        stats, and the ledger tail."""
+        from ..runtime.obs import ledger as obs_ledger
+
+        return {
+            "executor": self.executor.stats(),
+            "cache": self.cache.stats(),
+            "ledger": self.ledger_path,
+            "ledger_tail": (
+                obs_ledger.tail(self.ledger_path, ledger_tail)
+                if self.ledger_path else []
+            ),
+        }
 
     def submit(self, request: AnalysisRequest) -> AnalysisTicket:
         """Validate, fingerprint, and schedule (or join) a request.
@@ -243,6 +298,9 @@ class AnalysisService:
         self.close()
 
 
+CONTROL_TYPES = ("healthz", "stats")
+
+
 def parse_request_line(line: str) -> AnalysisRequest:
     doc = json.loads(line)
     if not isinstance(doc, dict):
@@ -258,6 +316,12 @@ def parse_request_line(line: str) -> AnalysisRequest:
     return AnalysisRequest(**doc)
 
 
+def _error_msg(e: Exception) -> str:
+    # KeyError's str() wraps the message in repr quotes; prefer the
+    # raw message for every single-arg exception
+    return str(e.args[0]) if len(e.args) == 1 else str(e)
+
+
 def serve_jsonl(service: AnalysisService, in_stream: IO,
                 out_stream: IO) -> int:
     """Process one JSONL request batch; returns the failure count.
@@ -265,36 +329,90 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     All parseable requests are submitted BEFORE any result is awaited,
     so duplicates inside the batch coalesce onto one execution, and
     responses come out in input order regardless of completion order.
+
+    Robustness contract: NOTHING on a request line aborts the stream.
+    Invalid JSON, a non-object line, unknown fields, a bad model, or
+    an execution blow-up each yield one structured error response
+    (`ok: false`, `line`, `error`) with the request `id` echoed
+    whenever the line parsed far enough to carry one. `healthz` /
+    `stats` lines (CONTROL_TYPES) answer inline from the service's
+    introspection snapshot taken as the line is read.
     """
-    entries: list = []  # (line_no, request|None, ticket|None, error)
+    # each entry: {"line", "id", and one of "ticket"+"request" |
+    # "control" | "error"}
+    entries: list[dict] = []
     for line_no, line in enumerate(in_stream, start=1):
         line = line.strip()
         if not line:
             continue
+        entry: dict = {"line": line_no, "id": None}
+        entries.append(entry)
+        try:
+            doc = json.loads(line)
+        except ValueError as e:
+            entry["error"] = f"invalid JSON: {e}"
+            continue
+        if isinstance(doc, dict):
+            # echo the id on EVERY response for this line, even when
+            # the rest of the request is malformed
+            entry["id"] = doc.get("id")
+        if isinstance(doc, dict) and doc.get("type") is not None:
+            kind = doc.get("type")
+            if kind not in CONTROL_TYPES:
+                entry["error"] = (
+                    f"unknown request type {kind!r} "
+                    f"(have {', '.join(CONTROL_TYPES)})"
+                )
+                continue
+            try:
+                entry["control"] = {
+                    "type": kind,
+                    "payload": (
+                        service.healthz() if kind == "healthz"
+                        else service.stats()
+                    ),
+                }
+            except Exception as e:
+                entry["error"] = f"introspection failed: {e!r}"
+            continue
         try:
             request = parse_request_line(line)
-            ticket = service.submit(request)
-            entries.append((line_no, request, ticket, None))
+            entry["ticket"] = service.submit(request)
+            entry["request"] = request
         except Exception as e:
-            # KeyError's str() wraps the message in repr quotes;
-            # prefer the raw message for every single-arg exception
-            msg = str(e.args[0]) if len(e.args) == 1 else str(e)
-            entries.append((line_no, None, None, msg))
+            entry["error"] = _error_msg(e)
     failures = 0
-    for line_no, request, ticket, error in entries:
-        if ticket is None:
+    for entry in entries:
+        if "control" in entry:
+            doc = {
+                "id": entry["id"],
+                "ok": True,
+                "type": entry["control"]["type"],
+                entry["control"]["type"]: entry["control"]["payload"],
+            }
+        elif "ticket" in entry:
+            try:
+                response = service.result(entry["ticket"])
+                doc = response.to_jsonl_dict()
+            except Exception as e:
+                # a result()/serialization blow-up is THIS request's
+                # error, never the batch's
+                doc = {
+                    "id": entry["request"].id,
+                    "ok": False,
+                    "line": entry["line"],
+                    "error": f"execution failed: {e!r}",
+                }
+            if not doc.get("ok"):
+                failures += 1
+        else:
             failures += 1
             doc = {
-                "id": (request.id if request else None),
+                "id": entry["id"],
                 "ok": False,
-                "line": line_no,
-                "error": error,
+                "line": entry["line"],
+                "error": entry["error"],
             }
-        else:
-            response = service.result(ticket)
-            if not response.ok:
-                failures += 1
-            doc = response.to_jsonl_dict()
         out_stream.write(json.dumps(doc) + "\n")
         out_stream.flush()
     return failures

@@ -114,8 +114,32 @@ class ResultCache:
         self.mem_entries = mem_entries
         self._mem: collections.OrderedDict = collections.OrderedDict()
         self._lock = threading.Lock()
+        # instance-local mirror of the telemetry counters: the serve
+        # introspection protocol (`stats` request) must report cache
+        # health even when no telemetry run is active
+        self._stats = collections.Counter()
         if self.cache_dir:
             os.makedirs(self.cache_dir, exist_ok=True)
+
+    def stats(self) -> dict:
+        """Lifetime counters + current occupancy, for the service's
+        `stats` introspection response."""
+        with self._lock:
+            out = dict(self._stats)
+            out.setdefault("hit_mem", 0)
+            out.setdefault("hit_disk", 0)
+            out.setdefault("miss", 0)
+            out.setdefault("corrupt", 0)
+            out.setdefault("evictions", 0)
+            out.setdefault("write_failed", 0)
+            out["mem_entries"] = len(self._mem)
+        out["mem_capacity"] = self.mem_entries
+        out["disk_tier"] = bool(self.cache_dir)
+        return out
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self._stats[key] += 1
 
     def path_for(self, fingerprint: str) -> str:
         if not self.cache_dir:
@@ -134,6 +158,7 @@ class ResultCache:
             rec = self._mem.get(fingerprint)
             if rec is not None:
                 self._mem.move_to_end(fingerprint)
+                self._stats["hit_mem"] += 1
                 telemetry.count("service_cache_hit_mem")
                 return rec, "mem"
         if self.cache_dir:
@@ -141,8 +166,10 @@ class ResultCache:
             if rec is not None:
                 with self._lock:
                     self._mem_put(fingerprint, rec)
+                self._count("hit_disk")
                 telemetry.count("service_cache_hit_disk")
                 return rec, "disk"
+        self._count("miss")
         telemetry.count("service_cache_miss")
         return None, "miss"
 
@@ -154,9 +181,11 @@ class ResultCache:
         except FileNotFoundError:
             return None
         except (OSError, ValueError):
+            self._count("corrupt")
             telemetry.count("service_cache_corrupt")
             return None
         if validate_record(rec, fingerprint):
+            self._count("corrupt")
             telemetry.count("service_cache_corrupt")
             return None
         return rec
@@ -174,6 +203,7 @@ class ResultCache:
             except OSError:
                 # a full/readonly disk degrades to memory-only serving;
                 # the result itself still reaches the caller
+                self._count("write_failed")
                 telemetry.count("service_cache_write_failed")
 
     def _mem_put(self, fingerprint: str, record: dict) -> None:
@@ -181,4 +211,5 @@ class ResultCache:
         self._mem.move_to_end(fingerprint)
         while len(self._mem) > self.mem_entries:
             self._mem.popitem(last=False)
+            self._stats["evictions"] += 1
             telemetry.count("service_cache_evictions")

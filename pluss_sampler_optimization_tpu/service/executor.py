@@ -38,6 +38,7 @@ engine internals.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -47,6 +48,7 @@ from ..ir import Program
 from ..runtime import report, telemetry
 from ..runtime.aet import aet_mrc
 from ..runtime.cri import cri_distribute
+from ..runtime.obs import ledger as obs_ledger
 from .cache import STORE_VERSION, ResultCache
 
 # Fallback order per requested engine: the exact family degrades
@@ -178,15 +180,53 @@ class RequestExecutor:
     `execute_request`. One instance backs one AnalysisService."""
 
     def __init__(self, cache: ResultCache | None = None,
-                 max_workers: int = 4, runner=default_runner):
+                 max_workers: int = 4, runner=default_runner,
+                 ledger_path: str | None = None):
         self.cache = cache if cache is not None else ResultCache()
         self.runner = runner
+        self.max_workers = max_workers
+        self.ledger_path = ledger_path
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers,
             thread_name_prefix="pluss-service",
         )
         self._inflight: dict[str, Future] = {}
         self._lock = threading.Lock()
+        # instance-local counters backing the serve `stats`/`healthz`
+        # introspection protocol — telemetry counters only exist while
+        # a run is enabled, but a long-lived service must answer
+        # introspection requests at any time
+        self._stats = collections.Counter()
+        if ledger_path:
+            # compile-counter deltas in ledger rows need the
+            # process-global jax.monitoring listeners; without jax the
+            # deltas simply stay empty
+            try:
+                telemetry.register_jax_hooks()
+            except Exception:
+                pass
+
+    def stats(self) -> dict:
+        """Executor health snapshot: queue depth (submitted futures
+        not yet executing), in-flight count, singleflight coalesces,
+        and the lifetime execution/degradation counters."""
+        with self._lock:
+            out = dict(self._stats)
+            inflight = len(self._inflight)
+        for key in ("submitted", "coalesced", "completed", "failed",
+                    "degraded", "deadline_abandoned", "active",
+                    "ledger_rows", "ledger_write_failed"):
+            out.setdefault(key, 0)
+        active = out.pop("active")
+        out["in_flight"] = inflight
+        out["executing"] = active
+        out["queue_depth"] = max(0, inflight - active)
+        out["max_workers"] = self.max_workers
+        return out
+
+    def _count(self, key: str, inc: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += inc
 
     # -- public -------------------------------------------------------
 
@@ -199,8 +239,10 @@ class RequestExecutor:
         one is in flight share its future."""
         telemetry.count("service_requests")
         with self._lock:
+            self._stats["submitted"] += 1
             fut = self._inflight.get(fingerprint)
             if fut is not None:
+                self._stats["coalesced"] += 1
                 telemetry.count("service_coalesced")
                 return fut
             fut = self._pool.submit(
@@ -230,24 +272,86 @@ class RequestExecutor:
     def _process(self, request, program, machine,
                  fingerprint: str) -> dict:
         t0 = time.perf_counter()
-        with telemetry.span("service_request", engine=request.engine,
-                            program=program.name):
-            record, tier = self.cache.get(fingerprint)
-            degraded: list[dict] = []
-            error = None
-            if record is None:
-                record, degraded, error = self._run_chain(
-                    request, program, machine, fingerprint
-                )
-                if record is not None and not degraded:
-                    self.cache.put(fingerprint, record)
-        return {
+        self._count("active")
+        compiles0 = (
+            telemetry.compile_counters_snapshot()
+            if self.ledger_path else None
+        )
+        try:
+            with telemetry.span("service_request",
+                                engine=request.engine,
+                                program=program.name):
+                record, tier = self.cache.get(fingerprint)
+                degraded: list[dict] = []
+                error = None
+                if record is None:
+                    record, degraded, error = self._run_chain(
+                        request, program, machine, fingerprint
+                    )
+                    if record is not None and not degraded:
+                        self.cache.put(fingerprint, record)
+        finally:
+            self._count("active", -1)
+        self._count("completed" if record is not None else "failed")
+        outcome = {
             "record": record,
             "cache": tier,
             "degraded": degraded,
             "error": error,
             "latency_s": round(time.perf_counter() - t0, 6),
+            "mrc_digest": (
+                obs_ledger.mrc_digest(record["mrc"])
+                if record is not None else None
+            ),
         }
+        if self.ledger_path:
+            self._append_ledger_row(
+                request, fingerprint, outcome, compiles0
+            )
+        return outcome
+
+    def _append_ledger_row(self, request, fingerprint: str,
+                           outcome: dict, compiles0: dict) -> None:
+        """One ledger row per execution (cache hits included, since a
+        served response is an execution of the SERVICE even when the
+        engine never ran; coalesced callers share the executing row).
+        A ledger failure must never sink the request — it is counted
+        and dropped."""
+        record = outcome["record"]
+        now = telemetry.compile_counters_snapshot()
+        compile_delta = {
+            k: v - compiles0.get(k, 0)
+            for k, v in now.items()
+            if v - compiles0.get(k, 0)
+        }
+        row = {
+            "kind": "request",
+            "source": "service",
+            "ok": record is not None,
+            "fingerprint": fingerprint,
+            "engine_requested": request.engine,
+            "engine_used": (
+                record.get("engine_used") if record else None
+            ),
+            "model": request.model,
+            "n": request.n,
+            "latency_s": outcome["latency_s"],
+            "cache": outcome["cache"],
+            "degraded": outcome["degraded"],
+            "compile_delta": {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in compile_delta.items()
+            },
+            "mrc_digest": outcome["mrc_digest"],
+        }
+        if outcome["error"] is not None:
+            row["error"] = str(outcome["error"])[:300]
+        try:
+            obs_ledger.append(self.ledger_path, row)
+            self._count("ledger_rows")
+        except Exception:
+            self._count("ledger_write_failed")
+            telemetry.count("service_ledger_write_failed")
 
     def _run_chain(self, request, program, machine, fingerprint):
         """Walk the degradation chain under the request deadline.
@@ -329,21 +433,22 @@ class RequestExecutor:
         t.start()
         t.join(budget_s)
         if t.is_alive():
+            self._count("deadline_abandoned")
             telemetry.count("service_deadline_abandoned")
             return None
         if "error" in box:
             raise box["error"]
         return box["record"]
 
-    @staticmethod
-    def _note_degrade(degraded, fingerprint, from_engine, to_engine,
-                      reason: str) -> None:
+    def _note_degrade(self, degraded, fingerprint, from_engine,
+                      to_engine, reason: str) -> None:
         info = {
             "from": from_engine,
             "to": to_engine,
             "reason": reason,
         }
         degraded.append(info)
+        self._count("degraded")
         telemetry.count("service_degraded")
         telemetry.event(
             "service_degraded", fingerprint=fingerprint, **info

@@ -221,9 +221,24 @@ def test_bench_emits_json_line(tmp_path):
             tele_doc = json.load(f)
         assert check_telemetry_schema.validate(tele_doc) == []
         assert tele_doc["counters"].get("dispatches", 0) > 0
+        # the run ledger got this run's headline row (bench appends by
+        # default), schema-valid and carrying the MRC digest
+        from pluss_sampler_optimization_tpu.runtime.obs import (
+            ledger as obs_ledger,
+        )
+
+        rows = obs_ledger.read_rows(os.path.join(REPO, "LEDGER.jsonl"))
+        bench_rows = [r for r in rows if r["kind"] == "bench"]
+        assert bench_rows, "bench run appended no ledger row"
+        last = bench_rows[-1]
+        assert last["metric"].startswith("gemm64_")
+        assert last["value"] > 0
+        assert len(last["mrc_digest"]) == 16
     finally:
         for name in created:
             if name.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY")):
+                os.remove(os.path.join(REPO, name))
+            if name == "LEDGER.jsonl":
                 os.remove(os.path.join(REPO, name))
     json_lines = [
         l for l in proc.stdout.splitlines() if l.startswith("{")
@@ -242,6 +257,9 @@ def test_bench_emits_json_line(tmp_path):
     doc = json.loads(json_lines[0])  # the full record
     # evidence names its telemetry sidecar so the two cross-reference
     assert doc["extra"]["telemetry"].startswith("BENCH_TELEMETRY_")
+    # ... and the run-ledger path, closing the evidence<->ledger loop
+    assert doc["extra"]["ledger"] == "LEDGER.jsonl"
+    assert doc["extra"]["mrc_digest"]
     assert doc["extra"]["analytic_exact"]["engine"] == "analytic"
     assert doc["extra"]["analytic_exact"]["mrc_l1_err"] == 0.0
     assert doc["unit"] == "samples/s/chip"

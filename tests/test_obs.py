@@ -1,0 +1,526 @@
+"""Observability subsystem: run ledger, Chrome-trace/Prometheus
+exporters, accuracy-drift monitoring, the ledger tools, and the CLI
+surface (--ledger / --trace-out / --metrics-out / stats mode).
+
+The ISSUE-4 acceptance invariants are pinned here: one serve session
+plus acc/speed runs produce a single valid ledger that
+tools/check_ledger.py validates and `cli stats` aggregates; the
+--trace-out span tree matches Telemetry.to_json's; drift audits pass
+on gemm + one non-gemm model with their metrics in the ledger; and
+engine output is bit-identical with observability enabled vs
+disabled.
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+import pytest
+
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime.obs import (
+    drift,
+    exporters,
+    ledger,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+import check_drift  # noqa: E402
+import check_ledger  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _request_row(**kw):
+    row = {
+        "kind": "request", "source": "test", "ok": True,
+        "fingerprint": "ab" * 32, "engine_requested": "exact",
+        "engine_used": "periodic", "model": "gemm", "n": 16,
+        "latency_s": 0.5, "cache": "miss", "degraded": [],
+        "mrc_digest": "0" * 16,
+    }
+    row.update(kw)
+    return row
+
+
+# -- ledger -----------------------------------------------------------
+
+
+def test_ledger_append_validate_read_round_trip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    r1 = ledger.append(path, _request_row())
+    assert r1["ledger_version"] == ledger.LEDGER_VERSION
+    assert r1["ts"] > 0
+    ledger.append(path, _request_row(cache="mem", latency_s=0.001))
+    rows = ledger.read_rows(path)
+    assert len(rows) == 2
+    assert rows[0]["cache"] == "miss" and rows[1]["cache"] == "mem"
+    assert ledger.tail(path, 1) == [rows[1]]
+    assert ledger.tail(str(tmp_path / "absent.jsonl")) == []
+    # each line is self-contained JSON (the append-only contract)
+    for line in open(path).read().splitlines():
+        assert json.loads(line)["kind"] == "request"
+
+
+def test_ledger_rejects_invalid_rows_before_write(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with pytest.raises(ValueError):
+        ledger.append(path, {"kind": "nope", "source": "t", "ok": True})
+    with pytest.raises(ValueError):
+        ledger.append(path, _request_row(cache="warm"))  # bad tier
+    with pytest.raises(ValueError):
+        ledger.append(path, _request_row(degraded="yes"))
+    assert not os.path.exists(path)  # nothing hit the file
+    assert ledger.validate_row(_request_row(
+        ledger_version=1, ts=1.0)) == []
+    assert ledger.validate_row("nope")
+    assert any(
+        "ledger_version" in e
+        for e in ledger.validate_row({"ledger_version": 99})
+    )
+
+
+def test_ledger_skips_truncated_tail_line(tmp_path):
+    """A crash mid-append leaves at most one partial line; readers
+    skip it and the validator reports it."""
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append(path, _request_row())
+    with open(path, "a") as f:
+        f.write('{"kind": "requ')  # torn write
+    rows = ledger.read_rows(path)
+    assert len(rows) == 1
+    entries = list(ledger.iter_rows(path))
+    assert len(entries) == 2
+    assert entries[1][1] is None and "invalid JSON" in entries[1][2]
+
+
+def test_mrc_digest_stability_and_sensitivity():
+    a = [1.0, 0.5, 0.25]
+    assert ledger.mrc_digest(a) == ledger.mrc_digest(list(a))
+    assert len(ledger.mrc_digest(a)) == 16
+    assert ledger.mrc_digest(a) != ledger.mrc_digest([1.0, 0.5, 0.2501])
+    import numpy as np
+
+    assert ledger.mrc_digest(np.asarray(a)) == ledger.mrc_digest(a)
+
+
+def test_ledger_aggregate_and_format():
+    rows = [
+        _request_row(ledger_version=1, ts=1.0),
+        _request_row(ledger_version=1, ts=2.0, cache="mem",
+                     latency_s=0.002),
+        _request_row(ledger_version=1, ts=3.0, ok=False, cache=None,
+                     latency_s=2.0, engine_used=None,
+                     degraded=[{"from": "exact", "to": "sampled",
+                                "reason": "x"}]),
+        {"kind": "drift", "source": "t", "ok": True, "breach": False,
+         "model": "gemm", "n": 32, "max_abs_delta": 0.1,
+         "mean_abs_delta": 0.01, "ledger_version": 1, "ts": 4.0},
+        {"kind": "bench", "source": "bench", "ok": True,
+         "metric": "gemm4096_sampled_throughput", "value": 1e8,
+         "ledger_version": 1, "ts": 5.0},
+    ]
+    for row in rows:
+        assert ledger.validate_row(row) == [], row
+    agg = ledger.aggregate(rows)
+    assert agg["rows"] == 5
+    assert agg["by_kind"] == {"request": 3, "drift": 1, "bench": 1}
+    ex = agg["requests"]["exact"]
+    assert ex["count"] == 3 and ex["ok"] == 2 and ex["failed"] == 1
+    assert ex["degraded"] == 1
+    assert ex["cache"] == {"mem": 1, "disk": 0, "miss": 1, "direct": 1}
+    assert ex["cache_hit_rate"] == 0.5  # 1 warm / 2 served
+    assert ex["p50_latency_s"] == 0.5
+    assert ex["p95_latency_s"] == 2.0
+    assert agg["drift"][0]["model"] == "gemm"
+    assert agg["bench_rows"] == 1
+    text = "\n".join(ledger.format_stats(agg))
+    assert "exact" in text and "drift gemm" in text
+
+
+# -- exporters --------------------------------------------------------
+
+
+def _make_run():
+    tele = telemetry.enable()
+    with telemetry.span("outer", tag="a"):
+        time.sleep(0.002)
+        with telemetry.span("inner1"):
+            time.sleep(0.002)
+        with telemetry.span("inner2"):
+            with telemetry.span("leaf", k=1):
+                time.sleep(0.002)
+    with telemetry.span("second_root"):
+        pass
+    telemetry.count("dispatches", 3)
+    telemetry.count("service_cache_hit_mem")
+    telemetry.gauge("queue_depth", 2)
+    telemetry.gauge("label", "not-a-number")  # must be skipped
+    telemetry.event("note", detail="x")
+    telemetry.disable()
+    return tele
+
+
+def test_chrome_trace_preserves_span_nesting():
+    tele = _make_run()
+    events = exporters.chrome_trace_events(tele)
+    spans = [e for e in events if e.get("cat") == "span"]
+    # per-root tracks, preorder within each
+    assert [(e["name"], e["tid"]) for e in spans] == [
+        ("outer", 1), ("inner1", 1), ("inner2", 1), ("leaf", 1),
+        ("second_root", 2),
+    ]
+    by_name = {e["name"]: e for e in spans}
+    for child, parent in (("inner1", "outer"), ("inner2", "outer"),
+                          ("leaf", "inner2")):
+        c, p = by_name[child], by_name[parent]
+        assert c["tid"] == p["tid"]
+        assert c["ts"] >= p["ts"] - 2.0  # trace times are micros
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 2.0
+    # attrs ride in args; instant events carry telemetry events
+    assert by_name["outer"]["args"] == {"tag": "a"}
+    assert by_name["leaf"]["args"] == {"k": 1}
+    inst = [e for e in events if e.get("ph") == "i"]
+    assert inst and inst[0]["name"] == "note"
+    assert inst[0]["args"]["detail"] == "x"
+    # trace_event phase/shape sanity for every span record
+    for e in spans:
+        assert e["ph"] == "X" and e["dur"] >= 0 and e["pid"] == 1
+
+
+def test_chrome_trace_sync_timings_preserved():
+    tele = telemetry.enable(device_sync=True)
+    with telemetry.span("dispatch") as sp:
+        sp.block([1, 2, 3])
+    telemetry.disable()
+    events = exporters.chrome_trace_events(tele)
+    span = next(e for e in events if e.get("cat") == "span")
+    assert span["args"]["sync_s"] >= 0
+
+
+def test_exporters_accept_doc_and_are_byte_stable(tmp_path):
+    tele = _make_run()
+    doc = tele.to_json()
+    # repeated exports of one stopped run are byte-identical, and the
+    # doc form (a saved --telemetry-out file) equals the live form
+    t1 = exporters.chrome_trace_text(tele)
+    t2 = exporters.chrome_trace_text(tele)
+    t3 = exporters.chrome_trace_text(doc)
+    assert t1 == t2 == t3
+    p1 = exporters.prometheus_text(tele)
+    assert p1 == exporters.prometheus_text(doc)
+    out = tmp_path / "trace.json"
+    exporters.write_chrome_trace(str(out), tele)
+    parsed = json.loads(out.read_text())
+    assert parsed["traceEvents"]  # valid JSON with the event list
+    # telemetry.exporters resolves to this module (the documented
+    # import surface)
+    assert telemetry.exporters is exporters
+
+
+_PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def test_prometheus_names_and_values():
+    tele = _make_run()
+    lines = exporters.prometheus_lines(tele)
+    samples = {}
+    for line in lines:
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split()
+            assert _PROM_NAME.match(name), name
+            assert mtype in ("counter", "gauge")
+        else:
+            name, value = line.split()
+            assert _PROM_NAME.match(name), name
+            samples[name] = float(value)
+    assert samples["pluss_dispatches_total"] == 3
+    assert samples["pluss_service_cache_hit_mem_total"] == 1
+    assert samples["pluss_queue_depth"] == 2
+    assert samples["pluss_run_duration_seconds"] > 0
+    assert "pluss_label" not in samples  # non-numeric gauge skipped
+    # counters sanitize through arbitrary telemetry names
+    assert _PROM_NAME.match(
+        exporters.prometheus_metric_name("weird/name:with-dots.x")
+    )
+
+
+def test_counted_lru_cache_exports_size_gauge():
+    """Satellite: cache occupancy vs maxsize is visible as gauges (and
+    therefore in the Prometheus export)."""
+
+    @telemetry.counted_lru_cache(maxsize=4, counter="test_cache")
+    def double(x):
+        return x * 2
+
+    tele = telemetry.enable()
+    assert double(1) == 2
+    assert double(1) == 2
+    assert double(2) == 4
+    telemetry.disable()
+    assert tele.counters["test_cache_misses"] == 2
+    assert tele.counters["test_cache_hits"] == 1
+    assert tele.gauges["test_cache_size"] == 2
+    assert tele.gauges["test_cache_maxsize"] == 4
+    text = exporters.prometheus_text(tele)
+    assert "pluss_test_cache_size 2" in text
+    assert "pluss_test_cache_maxsize 4" in text
+
+
+# -- CLI surface ------------------------------------------------------
+
+
+def test_cli_trace_out_matches_telemetry_span_tree(tmp_path, capsys):
+    """Acceptance: --trace-out emits Chrome-trace JSON whose span tree
+    matches Telemetry.to_json — same names, same preorder per root,
+    same timings."""
+    tele_out = str(tmp_path / "tele.json")
+    trace_out = str(tmp_path / "trace.json")
+    metrics_out = str(tmp_path / "metrics.prom")
+    assert main([
+        "acc", "--model", "gemm", "--n", "16", "--engine", "exact",
+        "--telemetry-out", tele_out, "--trace-out", trace_out,
+        "--metrics-out", metrics_out,
+    ]) == 0
+    capsys.readouterr()
+    tele_doc = json.load(open(tele_out))
+    trace_doc = json.load(open(trace_out))
+
+    def preorder(span, depth, out):
+        out.append((span["name"], depth,
+                    round(span["start_s"] * 1e6, 3),
+                    round(span["wall_s"] * 1e6, 3)))
+        for c in span["children"]:
+            preorder(c, depth + 1, out)
+
+    per_root = []
+    for root in tele_doc["spans"]:
+        out = []
+        preorder(root, 0, out)
+        per_root.append(out)
+    span_events = [
+        e for e in trace_doc["traceEvents"] if e.get("cat") == "span"
+    ]
+    for tid, expected in enumerate(per_root, start=1):
+        got = [
+            (e["name"], e["ts"], e["dur"])
+            for e in span_events if e["tid"] == tid
+        ]
+        assert got == [
+            (name, ts, dur) for name, _depth, ts, dur in expected
+        ]
+    assert len(span_events) == sum(len(x) for x in per_root) >= 3
+    # the Prometheus export carries the same counters
+    prom = open(metrics_out).read()
+    assert "pluss_dispatches_total" in prom
+
+
+def test_cli_obs_flags_bit_identical_output(tmp_path, capsys):
+    """Acceptance: MRCs (the full acc dump) are bit-identical with
+    observability enabled vs disabled."""
+    argv = ["acc", "--model", "syrk", "--n", "20", "--engine", "exact"]
+    assert main(argv) == 0
+    plain = capsys.readouterr().out
+    assert main(argv + [
+        "--ledger", str(tmp_path / "ledger.jsonl"),
+        "--trace-out", str(tmp_path / "trace.json"),
+        "--metrics-out", str(tmp_path / "metrics.prom"),
+    ]) == 0
+    observed = capsys.readouterr().out
+    assert observed == plain
+
+
+def test_cli_single_ledger_across_serve_and_runs(tmp_path, capsys):
+    """Acceptance: a full serve session plus acc and speed runs append
+    to ONE ledger; tools/check_ledger.py validates it and `cli stats`
+    aggregates it."""
+    led = str(tmp_path / "ledger.jsonl")
+    store = str(tmp_path / "store")
+    # serve session (cold + duplicate + control lines)
+    reqs = tmp_path / "reqs.jsonl"
+    resps = tmp_path / "resps.jsonl"
+    reqs.write_text("\n".join([
+        json.dumps({"id": "a", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "dup", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "s", "type": "stats"}),
+    ]) + "\n")
+    assert main([
+        "serve", "--requests", str(reqs), "--responses", str(resps),
+        "--cache-dir", store, "--ledger", led,
+    ]) == 0
+    # the stats response's ledger tail points into the same file
+    stats_line = json.loads(resps.read_text().splitlines()[-1])
+    assert stats_line["stats"]["ledger"] == led
+    # direct acc run + service speed run into the same ledger
+    assert main([
+        "acc", "--model", "gemm", "--n", "16", "--engine", "exact",
+        "--ledger", led,
+    ]) == 0
+    assert main([
+        "speed", "--model", "gemm", "--n", "16", "--engine", "oracle",
+        "--reps", "2", "--cache-dir", store, "--ledger", led,
+    ]) == 0
+    capsys.readouterr()
+
+    rows = ledger.read_rows(led)
+    entries = list(ledger.iter_rows(led))
+    assert len(rows) == len(entries)  # every line valid
+    sources = {r["source"] for r in rows}
+    assert sources == {"service", "cli"}
+    # serve wrote one row per EXECUTION (the duplicate coalesced or
+    # hit the memory tier, either way at most one engine execution)
+    serve_rows = [r for r in rows if r["source"] == "service"]
+    assert len(serve_rows) >= 1
+    assert all(len(r["fingerprint"]) == 64 for r in rows
+               if r["fingerprint"])
+    # direct and served runs join on digest fields
+    cli_rows = [r for r in rows if r["source"] == "cli"]
+    assert cli_rows and cli_rows[0]["mrc_digest"]
+
+    assert check_ledger.main([led]) == 0
+    out = capsys.readouterr().out
+    assert f"{len(rows)} valid, 0 invalid" in out
+
+    assert main(["stats", "--ledger", led]) == 0
+    stats_out = capsys.readouterr().out
+    assert "ledger:" in stats_out
+    assert "oracle" in stats_out and "exact" in stats_out
+
+
+def test_cli_stats_flag_validation(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["stats"])  # needs --ledger
+    with pytest.raises(SystemExit):
+        main(["stats", "--ledger", str(tmp_path / "absent.jsonl")])
+    with pytest.raises(SystemExit):
+        main(["trace", "--ledger", str(tmp_path / "l.jsonl")])
+
+
+def test_check_ledger_gc_compacts(tmp_path, capsys):
+    led = str(tmp_path / "ledger.jsonl")
+    for i in range(4):
+        ledger.append(led, _request_row(latency_s=float(i)))
+    with open(led, "a") as f:
+        f.write("{torn\n")
+    old = _request_row()
+    old["ledger_version"] = 1
+    old["ts"] = time.time() - 10 * 86400
+    with open(led, "a") as f:
+        f.write(json.dumps(old) + "\n")
+    assert check_ledger.main([led, "--max-age-days", "1"]) == 1
+    err = capsys.readouterr().err
+    assert "INVALID" in err and "stale" in err
+    assert check_ledger.main(
+        [led, "--max-age-days", "1", "--max-rows", "3", "--gc"]
+    ) == 0
+    capsys.readouterr()
+    rows = ledger.read_rows(led)
+    assert len(rows) == 3  # newest 3 of the 4 fresh rows
+    assert [r["latency_s"] for r in rows] == [1.0, 2.0, 3.0]
+    assert check_ledger.main([led]) == 0
+    assert check_ledger.main([str(tmp_path / "absent")]) == 1
+
+
+# -- drift monitoring -------------------------------------------------
+
+
+def test_drift_audit_records_ledger_row(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    tele = telemetry.enable()
+    row = drift.drift_audit(
+        "gemm", n=24,
+        thresholds={"max_abs_delta": 1.0, "mean_abs_delta": 1.0},
+        ledger_path=led,
+    )
+    telemetry.disable()
+    assert row["ok"] and not row["breach"]
+    assert row["kind"] == "drift"
+    assert 0 <= row["max_abs_delta"] <= 1.0
+    assert 0 <= row["mean_abs_delta"] <= row["max_abs_delta"]
+    assert row["support"] > 0
+    assert len(row["mrc_digest_exact"]) == 16
+    assert len(row["mrc_digest_sampled"]) == 16
+    assert row["mrc_digest_exact"] != row["mrc_digest_sampled"]
+    stored = ledger.read_rows(led)
+    assert len(stored) == 1 and stored[0]["model"] == "gemm"
+    assert stored[0]["engine_exact"] in (
+        "periodic", "analytic", "dense"
+    )
+    # the audit ran under the active telemetry run
+    assert tele.find_spans("drift_audit")
+    assert not tele.counters.get("drift_breach")
+
+
+def test_drift_breach_flags_telemetry_and_exit(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    tele = telemetry.enable()
+    row = drift.drift_audit(
+        "gemm", n=24,
+        thresholds={"max_abs_delta": 1e-6, "mean_abs_delta": 1e-6},
+        ledger_path=led,
+    )
+    telemetry.disable()
+    assert row["breach"] and not row["ok"]
+    assert tele.counters["drift_breach"] == 1
+    events = [e for e in tele.events if e["name"] == "drift_breach"]
+    assert events and events[0]["model"] == "gemm"
+    assert ledger.read_rows(led)[0]["breach"] is True
+    # the gate turns the breach into a nonzero exit
+    assert check_drift.main(
+        ["--models", "gemm", "--n", "24", "--max-abs", "1e-6"]
+    ) == 1
+
+
+def test_check_drift_gate_passes_gemm_and_non_gemm(tmp_path, capsys):
+    """Acceptance: the drift gate passes with DEFAULT thresholds on
+    gemm plus a non-gemm model, with the metrics recorded in the
+    ledger."""
+    led = str(tmp_path / "ledger.jsonl")
+    assert check_drift.main(
+        ["--models", "gemm,mvt", "--n", "24", "--ledger", led]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "gemm" in out and "mvt" in out and "BREACH" not in out
+    rows = ledger.read_rows(led)
+    assert [r["model"] for r in rows] == ["gemm", "mvt"]
+    assert all(r["kind"] == "drift" and r["ok"] for r in rows)
+    assert all(
+        r["max_abs_delta"] <= drift.DRIFT_THRESHOLDS["max_abs_delta"]
+        for r in rows
+    )
+    assert check_ledger.main([led]) == 0
+
+
+# -- bench ledger row shape (bench.py appends this) -------------------
+
+
+def test_bench_row_shape_validates_and_aggregates(tmp_path):
+    """The row bench.py appends (kind='bench' with the headline
+    metric + MRC digest) is schema-valid and lands in the stats
+    aggregate, so BENCH evidence and the ledger cross-reference."""
+    led = str(tmp_path / "ledger.jsonl")
+    ledger.append(led, {
+        "kind": "bench", "source": "bench", "ok": True,
+        "metric": "gemm4096_sampled_throughput", "value": 1.2e8,
+        "unit": "samples/s/chip", "vs_baseline": 40.0,
+        "engine": "sampled", "model": "gemm", "n": 4096,
+        "latency_s": 2.2, "device": "cpu",
+        "mrc_l1_err": 0.001, "mrc_digest": "ab" * 8,
+    })
+    agg = ledger.aggregate(ledger.read_rows(led))
+    assert agg["bench_rows"] == 1
+    assert check_ledger.main([led]) == 0

@@ -362,11 +362,14 @@ def test_serve_jsonl_round_trip(tmp_path, capsys):
     lines = [
         json.loads(ln) for ln in resps.read_text().splitlines()
     ]
-    assert [d["id"] for d in lines] == ["a", "dup", None, None]
+    # ids echo even on malformed-but-parseable request lines
+    assert [d["id"] for d in lines] == ["a", "dup", "bad", "uf"]
     a, dup, bad, uf = lines
     assert a["ok"] and a["engine_used"] == "oracle"
     assert a["mrc_lines"][0].startswith("0, ")
+    assert len(a["mrc_digest"]) == 16
     assert dup["ok"] and dup["fingerprint"] == a["fingerprint"]
+    assert dup["mrc_digest"] == a["mrc_digest"]
     assert not bad["ok"] and "unknown model" in bad["error"]
     assert not uf["ok"] and "wat" in uf["error"]
     # served dumps match the direct CLI acc output byte for byte
@@ -376,6 +379,146 @@ def test_serve_jsonl_round_trip(tmp_path, capsys):
     mrc_direct = direct.splitlines()
     i = mrc_direct.index("miss ratio")
     assert a["mrc_lines"] == mrc_direct[i + 1:-1]
+
+
+def test_serve_jsonl_malformed_lines_never_abort_the_stream(tmp_path):
+    """The robustness contract: invalid JSON, a non-object line, an
+    unknown control type, and a result() blow-up each yield one
+    structured error response; every later line still serves."""
+    import io
+
+    svc = AnalysisService()
+    fin = io.StringIO("\n".join([
+        '{"id": "j1", nope}',                    # invalid JSON
+        "[1, 2, 3]",                             # not an object
+        '"just a string"',                       # not an object
+        json.dumps({"id": "t1", "type": "selfdestruct"}),
+        json.dumps({"id": "ok1", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+    ]) + "\n")
+    fout = io.StringIO()
+    try:
+        failures = serve_jsonl(svc, fin, fout)
+    finally:
+        svc.close()
+    lines = [json.loads(ln) for ln in fout.getvalue().splitlines()]
+    assert len(lines) == 5
+    assert [d["ok"] for d in lines] == [
+        False, False, False, False, True,
+    ]
+    assert failures == 4
+    assert "invalid JSON" in lines[0]["error"]
+    assert lines[0]["line"] == 1
+    assert "JSON object" in lines[1]["error"]
+    assert lines[3]["id"] == "t1"
+    assert "unknown request type" in lines[3]["error"]
+    assert lines[4]["id"] == "ok1" and lines[4]["engine_used"] == "oracle"
+
+
+def test_serve_jsonl_result_failure_is_per_line(tmp_path):
+    """A request whose execution future blows up past the executor's
+    own error handling becomes that line's error response, not a
+    batch abort."""
+    import io
+
+    class _Boom:
+        def result(self, timeout=None):
+            raise RuntimeError("kaboom")
+
+    svc = AnalysisService()
+    real_submit = svc.submit
+
+    def submit(request):
+        ticket = real_submit(request)
+        if request.id == "boom":
+            ticket.future = _Boom()
+        return ticket
+
+    svc.submit = submit
+    fin = io.StringIO("\n".join([
+        json.dumps({"id": "boom", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "fine", "model": "gemm", "n": 18,
+                    "engine": "oracle"}),
+    ]) + "\n")
+    fout = io.StringIO()
+    try:
+        failures = serve_jsonl(svc, fin, fout)
+    finally:
+        svc.close()
+    lines = [json.loads(ln) for ln in fout.getvalue().splitlines()]
+    assert failures == 1
+    assert lines[0]["id"] == "boom" and not lines[0]["ok"]
+    assert "kaboom" in lines[0]["error"]
+    assert lines[1]["id"] == "fine" and lines[1]["ok"]
+
+
+def test_serve_healthz_and_stats_requests(tmp_path):
+    """The introspection protocol: healthz reports liveness + the
+    engine roster, stats reports executor/cache counters and the
+    ledger tail; a trailing stats line observes the batch's own
+    submissions."""
+    import io
+
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    svc = AnalysisService(cache_dir=str(tmp_path / "store"),
+                          ledger_path=ledger_path)
+    fin = io.StringIO("\n".join([
+        json.dumps({"id": "h", "type": "healthz"}),
+        json.dumps({"id": "r1", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "r2", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "s", "type": "stats"}),
+    ]) + "\n")
+    fout = io.StringIO()
+    try:
+        failures = serve_jsonl(svc, fin, fout)
+    finally:
+        svc.close()
+    assert failures == 0
+    lines = [json.loads(ln) for ln in fout.getvalue().splitlines()]
+    h, r1, r2, s = lines
+    assert h["ok"] and h["type"] == "healthz"
+    assert h["healthz"]["status"] == "ok"
+    assert "oracle" in h["healthz"]["engines"]
+    assert h["healthz"]["in_flight"] == 0
+    assert r1["ok"] and r2["ok"]
+    assert s["ok"] and s["type"] == "stats"
+    ex = s["stats"]["executor"]
+    # the stats snapshot is taken as the line is READ: both earlier
+    # submissions (one execution + one coalesce/duplicate) are visible
+    assert ex["submitted"] == 2
+    assert ex["max_workers"] == 4
+    assert set(ex) >= {"coalesced", "completed", "failed",
+                       "queue_depth", "in_flight", "degraded"}
+    cache = s["stats"]["cache"]
+    assert cache["disk_tier"] is True
+    assert cache["mem_capacity"] == 128
+    assert s["stats"]["ledger"] == ledger_path
+
+
+def test_service_stats_and_ledger_tail(tmp_path):
+    """AnalysisService.stats() outside the serve protocol: lifetime
+    counters move with executions and the ledger tail returns the
+    appended request rows."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    with AnalysisService(ledger_path=ledger_path) as svc:
+        r1 = svc.analyze(_req())
+        r2 = svc.analyze(_req())  # warm mem hit
+        st = svc.stats()
+    assert r1.ok and r2.ok and r2.cache == "mem"
+    assert r1.mrc_digest == r2.mrc_digest
+    assert st["executor"]["submitted"] == 2
+    assert st["executor"]["completed"] == 2
+    assert st["cache"]["hit_mem"] == 1
+    assert st["cache"]["miss"] == 1
+    tail = st["ledger_tail"]
+    assert len(tail) == 2
+    assert tail[0]["cache"] == "miss" and tail[1]["cache"] == "mem"
+    assert tail[0]["mrc_digest"] == r1.mrc_digest
+    assert tail[0]["fingerprint"] == r1.fingerprint
+    assert tail[1]["source"] == "service"
 
 
 def test_cli_cache_dir_acc_matches_direct(tmp_path, capsys):

@@ -1,0 +1,131 @@
+"""Validate (and optionally garbage-collect) a run ledger.
+
+The run ledger (pluss_sampler_optimization_tpu/runtime/obs/ledger.py)
+is an append-only JSONL file; writers validate rows before appending,
+so in normal operation every line is valid — but a crash can truncate
+the tail line, a version bump strands old rows, and a long-lived
+ledger grows without bound. This tool is the offline auditor, the
+tools/check_service_store.py pattern applied to the ledger:
+
+- invalid lines: unparseable JSON or schema violations (reported with
+  line numbers, via the SAME `validate_row` the writers use);
+- stale rows: older than --max-age-days (0 disables the age check);
+- with --max-rows N, rows beyond the newest N are surplus.
+
+With --gc the ledger is compacted in place (atomic rewrite keeping
+only valid, fresh rows — newest --max-rows of them) and the exit code
+is 0; without --gc the exit code is nonzero when anything invalid or
+stale was found, so CI can gate on ledger health.
+
+    python tools/check_ledger.py LEDGER.jsonl [--gc]
+        [--max-age-days N] [--max-rows N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def scan_ledger(path: str, max_age_days: float = 0.0,
+                max_rows: int = 0) -> dict:
+    """Classify every line. Returns {"valid": [rows...],
+    "invalid": [(line_no, error)], "stale": [rows...],
+    "surplus": [rows...]} — stale/surplus rows are valid rows that
+    --gc would drop."""
+    from pluss_sampler_optimization_tpu.runtime.obs import ledger
+
+    out: dict = {"valid": [], "invalid": [], "stale": [],
+                 "surplus": []}
+    now = time.time()
+    max_age_s = max_age_days * 86400.0
+    fresh: list = []
+    for line_no, row, error in ledger.iter_rows(path):
+        if row is None:
+            out["invalid"].append((line_no, error))
+            continue
+        if max_age_s > 0 and (now - float(row["ts"])) > max_age_s:
+            out["stale"].append(row)
+            continue
+        fresh.append(row)
+    if max_rows > 0 and len(fresh) > max_rows:
+        out["surplus"] = fresh[: len(fresh) - max_rows]
+        fresh = fresh[len(fresh) - max_rows:]
+    out["valid"] = fresh
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ledger", help="run ledger JSONL file")
+    ap.add_argument("--gc", action="store_true",
+                    help="compact the ledger in place (atomic "
+                    "rewrite), dropping invalid lines and stale/"
+                    "surplus rows instead of only reporting them")
+    ap.add_argument("--max-age-days", type=float, default=0.0,
+                    help="treat rows older than this as stale "
+                    "(0 = no age limit)")
+    ap.add_argument("--max-rows", type=int, default=0,
+                    help="with --gc keep only the newest N rows "
+                    "(0 = unbounded); without --gc surplus rows are "
+                    "reported")
+    args = ap.parse_args(argv)
+
+    if not os.path.isfile(args.ledger):
+        print(f"{args.ledger}: not a file", file=sys.stderr)
+        return 1
+
+    scan = scan_ledger(args.ledger, args.max_age_days, args.max_rows)
+    for line_no, error in scan["invalid"]:
+        print(f"{args.ledger}:{line_no}: INVALID: {error}",
+              file=sys.stderr)
+    if scan["stale"]:
+        print(
+            f"{args.ledger}: {len(scan['stale'])} stale row(s) "
+            f"(older than {args.max_age_days:g} days)",
+            file=sys.stderr,
+        )
+    if scan["surplus"]:
+        print(
+            f"{args.ledger}: {len(scan['surplus'])} surplus row(s) "
+            f"(beyond the newest {args.max_rows})",
+            file=sys.stderr,
+        )
+
+    n_bad = (
+        len(scan["invalid"]) + len(scan["stale"])
+        + len(scan["surplus"])
+    )
+    if args.gc and n_bad:
+        from pluss_sampler_optimization_tpu.runtime.io import (
+            atomic_write_text,
+        )
+
+        text = "".join(
+            json.dumps(row, sort_keys=True, separators=(",", ":"))
+            + "\n"
+            for row in scan["valid"]
+        )
+        atomic_write_text(args.ledger, text)
+
+    print(
+        f"{args.ledger}: {len(scan['valid'])} valid, "
+        f"{len(scan['invalid'])} invalid, {len(scan['stale'])} stale, "
+        f"{len(scan['surplus'])} surplus"
+        + (f"; compacted to {len(scan['valid'])} rows"
+           if args.gc and n_bad else "")
+    )
+    if args.gc:
+        return 0
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
