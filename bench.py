@@ -999,6 +999,60 @@ def main() -> int:
         except Exception as e:  # the headline metric must still print
             extra["second_model_error"] = repr(e)
 
+    # Cross-ref fused dispatch: wall time + dispatch count, fused vs
+    # unfused, same sampled config — the measured evidence behind the
+    # --fuse-refs default, with bit-identity asserted on the per-ref
+    # results (the fusion contract). Bounded at N<=1024 so the extra
+    # never rivals the headline run.
+    if extras_budget_left("ref_fusion", extra):
+        rf: dict = {}
+        extra["ref_fusion"] = rf
+        try:
+            import dataclasses as _dc
+
+            n_rf = min(args.n, 1024)
+            fprog = (prog if n_rf == args.n
+                     else REGISTRY[args.model](n_rf))
+            rf.update({"model": args.model, "n": n_rf})
+            fused_results: dict = {}
+            for label, fuse in (("fused", True), ("unfused", False)):
+                fcfg = _dc.replace(cfg, fuse_refs=fuse)
+                warmup(fprog, machine, fcfg)
+                d0 = tele.counters.get("dispatches", 0)
+                t0 = time.perf_counter()
+                _fstate, fres = run_sampled(fprog, machine, fcfg)
+                dt = time.perf_counter() - t0
+                fused_results[label] = fres
+                rf[label] = {
+                    "wall_s": round(dt, 4),
+                    "dispatches": int(
+                        tele.counters.get("dispatches", 0) - d0
+                    ),
+                }
+                if fuse:
+                    rf[label]["ref_buckets"] = tele.gauges.get(
+                        "ref_buckets"
+                    )
+                    rf[label]["expected_chunks"] = tele.gauges.get(
+                        "expected_chunks"
+                    )
+                    rf[label]["refs_per_dispatch"] = tele.gauges.get(
+                        "refs_per_dispatch"
+                    )
+            rf["bit_identical"] = (
+                fused_results["fused"] == fused_results["unfused"]
+            )
+            rf["dispatch_ratio"] = round(
+                rf["unfused"]["dispatches"]
+                / max(1, rf["fused"]["dispatches"]), 2,
+            )
+            rf["speedup"] = round(
+                rf["unfused"]["wall_s"]
+                / max(1e-9, rf["fused"]["wall_s"]), 2,
+            )
+        except Exception as e:  # never sink the headline metric
+            rf["error"] = repr(e)
+
     # Request-serving latency: the analysis service's cold-vs-warm
     # story measured on this host — one small exact request cold (the
     # engine executes and the result lands in a content-addressed

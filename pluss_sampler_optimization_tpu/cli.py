@@ -137,6 +137,10 @@ def _run_engine(engine: str, program, machine, args):
             kw["use_pallas_hist"] = args.pallas_hist
         if args.device_draw is not None:  # None = auto per backend
             kw["device_draw"] = args.device_draw
+        if args.fuse_refs is not None:  # None = keep config default
+            kw["fuse_refs"] = args.fuse_refs
+        if args.pipeline_depth is not None:
+            kw["pipeline_depth"] = args.pipeline_depth
         cfg = SamplerConfig(ratio=args.ratio, seed=args.seed, **kw)
         v2 = args.runtime == "v2"
         if engine == "sampled":
@@ -230,6 +234,19 @@ def main(argv=None) -> int:
                     "accelerator backends, OFF for CPU; each is that "
                     "backend's measured best, see "
                     "SamplerConfig.device_draw)")
+    ap.add_argument("--fuse-refs", default=None,
+                    action=argparse.BooleanOptionalAction,
+                    help="sampled/sharded engines: stack refs sharing "
+                    "a kernel-signature bucket into ONE vmapped "
+                    "dispatch per bucket (default: auto per backend — "
+                    "ON off-CPU, OFF on CPU; results are bit-identical "
+                    "either way — --no-fuse-refs keeps the per-ref "
+                    "serial loop as the parity oracle)")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="sampled engine: max in-flight dispatches "
+                    "awaiting their device->host fetch before the "
+                    "oldest is drained (config default: 4; forced "
+                    "drains count as pipeline_stalls in telemetry)")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
     ap.add_argument("--min-reuse", type=int, default=512,
@@ -534,6 +551,7 @@ def _request_from_args(args, engine):
         model=args.model, n=args.n, tsteps=args.tsteps, engine=engine,
         runtime=args.runtime, threads=args.threads, chunk=args.chunk,
         ratio=args.ratio, seed=args.seed, device_draw=args.device_draw,
+        fuse_refs=args.fuse_refs, pipeline_depth=args.pipeline_depth,
         deadline_s=args.deadline_s,
     )
 

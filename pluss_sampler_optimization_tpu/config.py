@@ -104,6 +104,27 @@ class SamplerConfig:
     # buffer exceeds draw.DEVICE_DRAW_MAX_SLOTS fall back to the host
     # path either way.
     device_draw: bool | None = None
+    # Cross-ref fused dispatch: refs sharing a kernel-signature bucket
+    # (sampler/sampled.py::_kernel_sig) have their padded key buffers
+    # stacked along a leading ref axis and classified by ONE vmapped
+    # scan-fused dispatch per bucket, instead of one dispatch per ref.
+    # Results are bit-identical to the per-ref path (the unique
+    # reductions are exact and the per-ref seeds are unchanged), so
+    # this is a pure dispatch-overhead knob; OFF preserves the legacy
+    # serial loop as the parity oracle. None = auto per backend (like
+    # device_draw): ON off-CPU, where every dispatch pays a round trip
+    # worth amortizing; OFF on CPU, where dispatch is cheap and the
+    # vmap-safe sorted merge costs more than the dispatches it saves
+    # (measured ~1.3x per element, gemm N=1024).
+    fuse_refs: bool | None = None
+    # Depth bound of the async dispatch pipeline: how many in-flight
+    # dispatches (fused buckets, or host chunks on the legacy path)
+    # may await their fetch before the oldest is drained. Each
+    # in-flight entry pins one dispatch's output (and, fused, its
+    # stacked input buffer) on device; raising it buys more
+    # host/device overlap at that memory cost. A forced drain counts
+    # as `pipeline_stalls` in telemetry.
+    pipeline_depth: int = 4
 
     def num_samples(self, trips) -> int:
         import math

@@ -47,18 +47,24 @@ from ..ops.histogram import (
     exp_hist,
     fixed_k_unique,
     merge_pair_sets,
+    sorted_k_unique,
 )
 from ..runtime import telemetry
 from ..runtime.hist import PRIState
 from ..sampler.dense import run_dense
-from ..sampler.draw import draw_sample_keys_device
+from ..sampler.draw import draw_bucket_keys_device, draw_sample_keys_device
 from ..sampler.sampled import (
     default_batch,
     DEFAULT_CAPACITY,
     SampledRefResult,
+    _bucket_rows,
+    _host_fuse_plan,
     _kernel_sig,
     _pad_highs,
+    _ref_sig_digest,
+    _sample_highs,
     _use_device_draw,
+    _use_fused,
     check_packed_ratios,
     classify_samples,
     decode_pairs,
@@ -198,6 +204,94 @@ def _build_sharded_ref_kernel(
     return jax.jit(entry)
 
 
+def _build_sharded_ref_kernel_fused(
+    nt: NestTrace, ref_idx: int, mesh: jax.sharding.Mesh, capacity: int,
+):
+    """Cross-ref fused twin of the scan-form sharded kernel: the
+    bucket's stacked (R, B) key/mask buffers arrive sharded over the
+    mesh along the SAMPLE axis (P(None, axis)), each device vmaps the
+    per-ref local scan over the leading ref axis, and the mesh
+    reduction runs once on the stacked outputs — one dispatch and one
+    fetch per bucket instead of per ref.
+
+    Two deliberate differences from the per-ref form, neither visible
+    in results: the unique reductions are sorted_k_unique (under vmap
+    fixed_k_unique's lax.cond would run its sort branch anyway — see
+    its docstring) and the dense noshare histogram is exp_hist
+    unconditionally (the Pallas ladder is pinned bit-equal to exp_hist
+    where it engages, and vmapping a Pallas call is not a supported
+    path here). Every reduction is exact integer math, so fused-bucket
+    results are bit-identical to the per-ref sharded path — the
+    sharded fusion tests pin it."""
+    axis = mesh.axis_names[0]
+    check_packed_ratios(nt)
+
+    def local_fn(keys_RB, mask_RB, highs, vals, rx_R, n_chunks):
+        snt = nt.with_vals(vals)
+
+        def one_ref(keys_B, mask_B, rx):
+            kb = keys_B.reshape(n_chunks, -1)
+            mb = mask_B.reshape(n_chunks, -1)
+
+            def step(carry, xm):
+                ck, cc, cold, max_nu, nh = carry
+                x, msk = xm
+                samples = decode_sample_keys(x, highs)
+                packed, ri_v, is_share, found = classify_samples(
+                    snt, ref_idx, samples, rx
+                )
+                nosh = exp_hist(
+                    jnp.maximum(ri_v, 1), (found & ~is_share & msk)
+                )
+                k2, c2, nu = sorted_k_unique(
+                    packed, found & msk, capacity
+                )
+                w = jnp.concatenate([cc, c2])
+                mk, mc, mnu = sorted_k_unique(
+                    jnp.concatenate([ck, k2]), w > 0, capacity,
+                    weights=w,
+                )
+                return (
+                    mk, mc,
+                    cold + jnp.sum((~found & msk).astype(jnp.int64)),
+                    jnp.maximum(max_nu, jnp.maximum(nu, mnu)),
+                    nh + nosh,
+                ), None
+
+            init = (
+                jnp.full(capacity, -1, dtype=jnp.int64),
+                jnp.zeros(capacity, dtype=jnp.int64),
+                jnp.int64(0),
+                jnp.int64(0),
+                jnp.zeros(N_EXP_BINS, dtype=jnp.int64),
+            )
+            (mk, mc, cold, max_nu, nh), _ = jax.lax.scan(
+                step, init, (kb, mb)
+            )
+            return mk, mc, cold, max_nu, nh
+
+        mk, mc, cold, max_nu, nh = jax.vmap(
+            one_ref, in_axes=(0, 0, 0)
+        )(keys_RB, mask_RB, rx_R)
+        return (
+            jax.lax.psum(nh, axis),          # (R, bins)
+            jax.lax.psum(cold, axis),        # (R,)
+            jax.lax.all_gather(mk, axis),    # (n_dev, R, capacity)
+            jax.lax.all_gather(mc, axis),
+            jax.lax.all_gather(max_nu, axis),  # (n_dev, R)
+        )
+
+    def entry(keys_RB, mask_RB, highs, vals, rx_R, n_chunks: int):
+        return _shard_map(
+            functools.partial(local_fn, n_chunks=n_chunks),
+            mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+        )(keys_RB, mask_RB, highs, vals, rx_R)
+
+    return jax.jit(entry, static_argnames=("n_chunks",))
+
+
 # (sig, mesh, capacity, pallas, scan) -> shared jitted kernel; same
 # sharing rule as sampler/sampled.py::_SIG_KERNELS — structure in the
 # closure, every N-dependent number in the highs/vals operands.
@@ -224,6 +318,25 @@ def _sharded_kernels_for(
          use_pallas_hist, scan),
         lambda: _build_sharded_ref_kernel(
             nt, ref_idx, mesh, capacity, use_pallas_hist, scan
+        ),
+        _SHARDED_SIG_KERNELS_MAX,
+    )
+
+
+def _sharded_fused_kernels_for(
+    nt: NestTrace, ref_idx: int, mesh, capacity: int,
+):
+    """Fused-bucket variant of _sharded_kernels_for; keyed "fused" so
+    it never collides with the per-ref forms."""
+    from ..sampler.sampled import lru_cached
+    from ..service.fingerprint import structure_digest
+
+    return lru_cached(
+        _SHARDED_SIG_KERNELS,
+        (structure_digest(_kernel_sig(nt, ref_idx)), mesh, capacity,
+         "fused"),
+        lambda: _build_sharded_ref_kernel_fused(
+            nt, ref_idx, mesh, capacity
         ),
         _SHARDED_SIG_KERNELS_MAX,
     )
@@ -313,6 +426,17 @@ def sampled_outputs_sharded(
             stacklevel=2,
         )
         use_dev_draw = False
+    if _use_fused(cfg) and n_proc == 1:
+        # Cross-ref fused dispatch (sampler/sampled.py's bucket plan)
+        # on the mesh: one vmapped shard_map dispatch per kernel-
+        # signature bucket. Single-process only — the multi-process
+        # buffer assembly (_buffer_to_global) is per-ref 1-D and a
+        # stacked 2-D equivalent is not worth the complexity for the
+        # per-ref dispatch count multi-host runs already amortize —
+        # so n_proc > 1 keeps the per-ref loop below.
+        return _sampled_outputs_sharded_fused(
+            trace, cfg, mesh, batch, capacity, use_dev_draw
+        )
     scan_kernels = None
     if use_dev_draw:
         # lru-cached like the host-form kernels (scan=True keys a
@@ -456,6 +580,194 @@ def sampled_outputs_sharded(
             )
         )
         dense_noshare.append(dense)
+    return results, dense_noshare
+
+
+def _sampled_outputs_sharded_fused(
+    trace: ProgramTrace,
+    cfg: SamplerConfig,
+    mesh: jax.sharding.Mesh,
+    batch: int,
+    capacity: int,
+    use_dev_draw: bool,
+):
+    """Cross-ref fused form of sampled_outputs_sharded (single
+    process): refs are grouped into the same kernel-signature buckets
+    as sampler/sampled.py and each bucket's stacked (R, B) buffers go
+    through ONE vmapped shard_map dispatch
+    (_build_sharded_ref_kernel_fused), with the capacity-regrow loop
+    running per bucket dispatch. Same draw streams, same exact merges
+    — bit-identical to both the per-ref sharded loop and run_sampled.
+    """
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    n_proc = jax.process_count()
+    assert n_proc == 1, "fused sharded path is single-process only"
+    stack_sharding = NamedSharding(mesh, P(None, axis))
+    rows = []
+    for k, nt in enumerate(trace.nests):
+        for ri in range(nt.tables.n_refs):
+            rows.append((k, ri, None, _ref_sig_digest(nt, ri)))
+    noshare = {idx: {} for idx in range(len(rows))}
+    share = {idx: {} for idx in range(len(rows))}
+    cold = {idx: 0.0 for idx in range(len(rows))}
+    dense = {idx: np.zeros(N_EXP_BINS, dtype=np.int64)
+             for idx in range(len(rows))}
+    n_samples_of = {idx: 0 for idx in range(len(rows))}
+    cap = capacity
+    n_buckets = 0
+    max_bucket_dispatches = 0
+    n_fused = 0
+    n_refs_fused = 0
+
+    def run_bucket(nt, ri0, mem, make_inputs, ph, rx_R, n_chunks):
+        """One fused bucket dispatch + its per-bucket regrow loop."""
+        nonlocal cap, n_fused, n_refs_fused
+        dispatch_cap = cap
+        while True:
+            kern = _sharded_fused_kernels_for(nt, ri0, mesh,
+                                              dispatch_cap)
+            keys_RB, mask_RB = make_inputs()
+            with telemetry.span("dispatch_psum", form="fused",
+                                refs=len(mem)):
+                telemetry.count("dispatches")
+                telemetry.count("dispatches_fused")
+                out = kern(keys_RB, mask_RB, ph, nt.vals, rx_R,
+                           n_chunks)
+            with telemetry.span("gather_fetch", fused=True):
+                nh, c, keys, counts, max_nu = telemetry.record_fetch(
+                    jax.device_get(out)
+                )
+            if int(max_nu.max(initial=0)) <= dispatch_cap:
+                break
+            # regrow ONCE for the whole bucket dispatch, then re-run
+            telemetry.count("capacity_regrows")
+            dispatch_cap = max(dispatch_cap * 4,
+                               int(max_nu.max(initial=0)))
+            cap = max(cap, dispatch_cap)
+        n_fused += 1
+        n_refs_fused += len(mem)
+        with telemetry.span("merge"):
+            for j, idx in enumerate(mem):
+                dense[idx] += nh[j]
+                cold[idx] += float(c[j])
+                for d in range(n_dev):
+                    decode_pairs(keys[d, j], counts[d, j],
+                                 noshare[idx], share[idx])
+
+    step = max(n_dev, (batch // n_dev) * n_dev)
+    for (k, sig), members in _bucket_rows(trace, rows).items():
+        nt = trace.nests[k]
+        ri0 = members[0][1]
+        highs, s = _sample_highs(nt, ri0, cfg)
+        if s == 0:
+            continue
+        n_buckets += 1
+        bucket_dispatches = 0
+        bspan = telemetry.span(
+            "bucket", engine="sharded", nest=k,
+            refs=",".join(nt.tables.ref_names[ri] for _, ri in members),
+        )
+        bspan.__enter__()
+        ph = _pad_highs(highs)
+        drawn = None
+        if use_dev_draw:
+            with telemetry.span("draw", where="device"):
+                drawn = draw_bucket_keys_device(
+                    nt, [ri for _, ri in members], cfg,
+                    [cfg.seed * 1000003 + idx for idx, _ in members],
+                    batch,
+                )
+        host_members = []
+        dev_groups: dict[int, list] = {}
+        if drawn is None:
+            host_members = members
+        else:
+            for (idx, ri), d in zip(members, drawn):
+                if d is None:
+                    host_members.append((idx, ri))
+                    continue
+                sk, chosen, s_m, _hi = d
+                n_samples_of[idx] = s_m
+                dev_groups.setdefault(int(sk.shape[0]), []).append(
+                    (idx, ri, sk, chosen)
+                )
+        for B, grp in dev_groups.items():
+            rx_R = jnp.asarray([ri for _, ri, _, _ in grp], jnp.int64)
+
+            def make_inputs(grp=grp):
+                with telemetry.span("shard_put",
+                                    rows=len(grp) * grp[0][2].shape[0]):
+                    return (
+                        jax.device_put(
+                            jnp.stack([sk for _, _, sk, _ in grp]),
+                            stack_sharding,
+                        ),
+                        jax.device_put(
+                            jnp.stack([ch for _, _, _, ch in grp]),
+                            stack_sharding,
+                        ),
+                    )
+
+            run_bucket(nt, grp[0][1], [idx for idx, _, _, _ in grp],
+                       make_inputs, ph, rx_R, B // batch)
+            bucket_dispatches += 1
+        if host_members:
+            with telemetry.span("draw", where="host"):
+                keys_list = []
+                for idx, ri in host_members:
+                    keys_all, _hi = draw_sample_keys(
+                        nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+                    )
+                    n_samples_of[idx] = len(keys_all)
+                    keys_list.append(keys_all)
+            n_samples = len(keys_list[0])
+            g, n_groups = _host_fuse_plan(n_samples, step)
+            span_len = g * step
+            rx_R = jnp.asarray([ri for _, ri in host_members],
+                               jnp.int64)
+            mem = [idx for idx, _ in host_members]
+            for gi in range(n_groups):
+                lo = gi * span_len
+
+                def make_inputs(lo=lo, kl=keys_list,
+                                span_len=span_len):
+                    buf = np.empty((len(kl), span_len),
+                                   dtype=np.int64)
+                    msk = np.zeros((len(kl), span_len), dtype=bool)
+                    for j, ka in enumerate(kl):
+                        seg = ka[lo:lo + span_len]
+                        buf[j, :len(seg)] = seg
+                        buf[j, len(seg):] = ka[0]  # decodable padding
+                        msk[j, :len(seg)] = True
+                    with telemetry.span("shard_put",
+                                        rows=buf.size):
+                        return (
+                            jax.device_put(buf, stack_sharding),
+                            jax.device_put(msk, stack_sharding),
+                        )
+
+                run_bucket(nt, host_members[0][1], mem, make_inputs,
+                           ph, rx_R, g)
+                bucket_dispatches += 1
+        bspan.__exit__(None, None, None)
+        max_bucket_dispatches = max(max_bucket_dispatches,
+                                    bucket_dispatches)
+    telemetry.gauge("fuse_refs", 1)
+    telemetry.gauge("ref_buckets", n_buckets)
+    telemetry.gauge("expected_chunks", max_bucket_dispatches)
+    if n_fused:
+        telemetry.gauge("refs_per_dispatch", n_refs_fused / n_fused)
+    results = []
+    dense_noshare = []
+    for idx, (k, ri, _ks, _sig) in enumerate(rows):
+        nt = trace.nests[k]
+        results.append(SampledRefResult(
+            name=nt.tables.ref_names[ri], noshare=noshare[idx],
+            share=share[idx], cold=cold[idx],
+            n_samples=n_samples_of[idx],
+        ))
+        dense_noshare.append(dense[idx])
     return results, dense_noshare
 
 

@@ -16,6 +16,14 @@ boxes between dispatch and fetch. This module is the shared spine:
 - **Counters / gauges / events.** `count("dispatch")` style counters
   (the engines count dispatches and bytes fetched to host), free-form
   gauges, and bounded structured events (`event("accel_probe", ...)`).
+  The sampled engine's cross-ref fusion adds a small contract here:
+  `dispatches_fused` counts dispatches that carried a stacked ref
+  bucket, `pipeline_stalls` counts forced drains of the depth-bounded
+  async pipeline, and the end-of-run gauges `ref_buckets`,
+  `expected_chunks`, `refs_per_dispatch`, and `pipeline_overlap_s`
+  describe the bucket plan — tools/check_dispatch_stats.py audits
+  `dispatches <= ref_buckets * expected_chunks + capacity_regrows`
+  from an exported run to catch silent fusion regressions.
 - **jax.monitoring capture.** A process-global listener pair
   (registered once — jax listeners cannot be unregistered) accumulates
   EVERY monitoring event count and duration by key; each `Telemetry`

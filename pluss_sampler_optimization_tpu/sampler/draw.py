@@ -141,6 +141,21 @@ def _select_exact(sk, valid_first, s, pri_key):
     return chosen, U, jnp.sum(chosen.astype(jnp.int64))
 
 
+def _rect_draw_body(rng_key, space, s, B: int):
+    """One rectangular draw+dedup+thin: shared by the per-ref kernel
+    and its vmapped bucket twin (threefry streams are counter-based per
+    key, so the vmapped rows are bit-identical to per-ref calls —
+    pinned by tests/test_draw.py)."""
+    k1, k2 = jr.split(rng_key)
+    keys = jr.randint(k1, (B,), 0, space, dtype=jnp.int64)
+    sk = jnp.sort(keys)
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), sk[1:] != sk[:-1]]
+    )
+    chosen, U, n_chosen = _select_exact(sk, first, s, k2)
+    return sk, chosen, U, n_chosen
+
+
 @telemetry.counted_lru_cache(maxsize=32)
 def _rect_draw_kernel(B: int):
     """Shared draw kernel for rectangular refs: every ref/model/N with
@@ -148,16 +163,80 @@ def _rect_draw_kernel(B: int):
 
     @jax.jit
     def draw(rng_key, space, s):
-        k1, k2 = jr.split(rng_key)
-        keys = jr.randint(k1, (B,), 0, space, dtype=jnp.int64)
-        sk = jnp.sort(keys)
-        first = jnp.concatenate(
-            [jnp.ones(1, bool), sk[1:] != sk[:-1]]
-        )
-        chosen, U, n_chosen = _select_exact(sk, first, s, k2)
-        return sk, chosen, U, n_chosen
+        return _rect_draw_body(rng_key, space, s, B)
 
     return draw
+
+
+@telemetry.counted_lru_cache(maxsize=32)
+def _rect_draw_kernel_batch(R: int, B: int):
+    """Bucket form of _rect_draw_kernel: one dispatch draws every
+    member of a signature bucket, vmapped over the (R,) stacked rng
+    keys. Same per-row bits as R separate per-ref dispatches."""
+
+    @jax.jit
+    def draw(rng_keys, space, s):
+        return jax.vmap(
+            _rect_draw_body, in_axes=(0, None, None, None)
+        )(rng_keys, space, s, B)
+
+    return draw
+
+
+def _draw_base_key(seed: int):
+    """The per-ref threefry base key; split out so the bucket draw
+    folds attempt 0 exactly as draw_sample_keys_device's retry loop."""
+    base = jr.key(np.uint32(seed & 0xFFFFFFFF))
+    return jr.fold_in(base, np.uint32((seed >> 32) & 0xFFFFFFFF))
+
+
+def draw_bucket_keys_device(nt, ref_indices, cfg, seeds, batch: int):
+    """Device draw for a whole kernel-signature bucket in (ideally) one
+    vmapped dispatch.
+
+    `ref_indices` share one kernel signature, hence one draw plan
+    (same highs, s, buffer size B); `seeds` are their per-ref seeds in
+    the same order. Returns a list parallel to ref_indices of
+    (keys (B,), chosen (B,), s, highs) entries — an entry is None when
+    that member cannot take the device path (the caller routes it to
+    the host draw, exactly like the per-ref fallback). Returns None
+    when the whole bucket is off the device path (no plan, or a
+    triangular bucket — tri signatures are per-ref, so those buckets
+    are singletons and take the per-ref draw).
+
+    Bit-identity contract: attempt 0 is the vmapped twin of the
+    per-ref kernel (same fold sequence, same threefry rows — pinned by
+    tests/test_draw.py); the rare shortfall member replays the full
+    per-ref retry loop, which deterministically re-fails attempt 0 and
+    continues with the identical grown-buffer stream.
+    """
+    plan = plan_draw(nt, ref_indices[0], cfg, batch)
+    if plan is None:
+        return None
+    B, tri, s, highs, excl, space_box = plan
+    if tri or len(ref_indices) == 1:
+        out = [
+            draw_sample_keys_device(nt, ri, cfg, seed=sd, batch=batch)
+            for ri, sd in zip(ref_indices, seeds)
+        ]
+        return None if all(o is None for o in out) else out
+    bases = jnp.stack([jr.fold_in(_draw_base_key(sd), 0) for sd in seeds])
+    kern = _rect_draw_kernel_batch(len(seeds), B)
+    sk, chosen, U, n_chosen = kern(
+        bases, jnp.int64(space_box), jnp.int64(s)
+    )
+    Uh, nh = np.asarray(U), np.asarray(n_chosen)
+    out = []
+    for j, (ri, sd) in enumerate(zip(ref_indices, seeds)):
+        if int(Uh[j]) >= s and int(nh[j]) == s:
+            out.append((sk[j], chosen[j], s, highs))
+        else:
+            # shortfall or 2^-64 priority tie: replay this member
+            # through the per-ref retry loop (deterministic)
+            out.append(draw_sample_keys_device(
+                nt, ri, cfg, seed=sd, batch=batch
+            ))
+    return out
 
 
 def _build_tri_draw_kernel(nt, ref_idx: int, highs: tuple, excl: int, B: int):
@@ -215,8 +294,7 @@ def draw_sample_keys_device(
         return None
     B, tri, s, highs, excl, space_box = plan
 
-    base = jr.key(np.uint32(seed & 0xFFFFFFFF))
-    base = jr.fold_in(base, np.uint32((seed >> 32) & 0xFFFFFFFF))
+    base = _draw_base_key(seed)
     for attempt in range(8):
         rng_key = jr.fold_in(base, attempt)
         if tri:

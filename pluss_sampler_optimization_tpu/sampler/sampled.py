@@ -43,7 +43,7 @@ import numpy as np
 from ..config import MachineConfig, SamplerConfig
 from ..core.trace import NestTrace, ProgramTrace
 from ..ir import Program
-from ..ops.histogram import fixed_k_unique, merge_pair_sets
+from ..ops.histogram import fixed_k_unique, merge_pair_sets, sorted_k_unique
 from ..runtime import telemetry
 from ..runtime.hist import PRIState
 from .nextuse import INF
@@ -432,20 +432,29 @@ _SIG_KERNELS: "_collections.OrderedDict" = _collections.OrderedDict()
 _SIG_KERNELS_MAX = 64
 
 
-def _kernels_for(nt: NestTrace, ref_idx: int) -> dict:
+def _ref_sig_digest(nt: NestTrace, ref_idx: int) -> str:
+    """Canonical digest of the ref's kernel signature — the kernel
+    cache key AND the cross-ref fusion bucket id (refs of one nest
+    sharing a digest share one compiled kernel, so their buffers can
+    stack into one vmapped dispatch)."""
+    from ..service.fingerprint import structure_digest
+
+    return structure_digest(_kernel_sig(nt, ref_idx))
+
+
+def _kernels_for(nt: NestTrace, ref_idx: int, digest: str | None = None):
     # keyed by the canonical digest of the signature tuple — the same
     # content-hash discipline the service's result store uses
     # (service/fingerprint.py::structure_digest); distinctness is
     # exactly the signature's, so the sharing contract pinned by
     # tests/test_compile_sharing.py is unchanged
-    from ..service.fingerprint import structure_digest
-
     return lru_cached(
         _SIG_KERNELS,
-        structure_digest(_kernel_sig(nt, ref_idx)),
+        digest if digest is not None else _ref_sig_digest(nt, ref_idx),
         lambda: {
             "plain": _build_ref_kernel(nt, ref_idx),
             "scan": _build_ref_kernel_scan(nt, ref_idx),
+            "fused": _build_ref_kernel_fused(nt, ref_idx),
             "masked": _build_ref_kernel_masked(nt, ref_idx),
             "raw": _build_ref_kernel_raw(nt, ref_idx),
         },
@@ -547,6 +556,82 @@ def _build_ref_kernel_scan(nt: NestTrace, ref_idx: int):
         )
         (mk, mc, cold, max_nu), _ = jax.lax.scan(step, init, (kb, mb))
         return mk, mc, max_nu, cold
+
+    return kernel
+
+
+def _build_ref_kernel_fused(nt: NestTrace, ref_idx: int):
+    """Cross-ref bucket twin of _build_ref_kernel_scan: the stacked
+    (R, B) key/mask buffers of every ref in one kernel-signature bucket
+    are classified by ONE dispatch, vmapped over the leading ref axis
+    (the value-lookup index arrives as an (R,) rx operand — the same
+    trick that lets structurally identical refs share a compile,
+    batched). Per-ref (keys, counts, max_nu, cold) come back stacked;
+    the host decodes each row into its own ref's histograms.
+
+    Inside vmap the unique reductions are sorted_k_unique, not
+    fixed_k_unique: under vmap the latter's lax.cond fallback lowers to
+    a select that executes its sort branch on every call (see the
+    fixed_k_unique docstring), so the hash rounds would be pure
+    overhead here. Both reductions are exact with identical
+    (keys, counts, n_unique) outputs, so the fused path stays
+    bit-identical to the serial kernels at the decoded-result level —
+    the fusion on/off tests pin it.
+
+    The stacked key/mask buffers are donated on accelerator backends
+    (the CPU runtime does not implement donation and would warn):
+    regrows and back-to-back bucket dispatches then reuse the pages
+    instead of double-allocating. The drain loop re-materializes
+    inputs through its make_inputs thunk when it must re-dispatch.
+    """
+    check_packed_ratios(nt)
+    donate = () if jax.default_backend() == "cpu" else (0, 1)
+
+    @functools.partial(
+        jax.jit, static_argnames=("capacity", "n_chunks"),
+        donate_argnums=donate,
+    )
+    def kernel(keys_RB, mask_RB, highs, vals, rx_R, capacity: int,
+               n_chunks: int):
+        snt = nt.with_vals(vals)
+
+        def one_ref(keys_B, mask_B, rx):
+            kb = keys_B.reshape(n_chunks, -1)
+            mb = mask_B.reshape(n_chunks, -1)
+
+            def step(carry, xm):
+                ck, cc, cold, max_nu = carry
+                x, msk = xm
+                samples = decode_sample_keys(x, highs)
+                packed, _, _, found = classify_samples(
+                    snt, ref_idx, samples, rx
+                )
+                k2, c2, nu = sorted_k_unique(
+                    packed, found & msk, capacity
+                )
+                w = jnp.concatenate([cc, c2])
+                mk, mc, mnu = sorted_k_unique(
+                    jnp.concatenate([ck, k2]), w > 0, capacity,
+                    weights=w,
+                )
+                cold = cold + jnp.sum((~found & msk).astype(jnp.int64))
+                max_nu = jnp.maximum(max_nu, jnp.maximum(nu, mnu))
+                return (mk, mc, cold, max_nu), None
+
+            init = (
+                jnp.full(capacity, -1, dtype=jnp.int64),
+                jnp.zeros(capacity, dtype=jnp.int64),
+                jnp.int64(0),
+                jnp.int64(0),
+            )
+            (mk, mc, cold, max_nu), _ = jax.lax.scan(
+                step, init, (kb, mb)
+            )
+            return mk, mc, max_nu, cold
+
+        return jax.vmap(one_ref, in_axes=(0, 0, 0))(
+            keys_RB, mask_RB, rx_R
+        )
 
     return kernel
 
@@ -687,9 +772,48 @@ def _program_kernels(program: Program, machine: MachineConfig):
                 "or stream engine"
             )
         for ri in range(nt.tables.n_refs):
-            ks = _kernels_for(nt, ri)
-            kernels.append((k, ri, ks["plain"], ks["scan"]))
+            sig = _ref_sig_digest(nt, ri)
+            ks = _kernels_for(nt, ri, sig)
+            kernels.append((k, ri, ks, sig))
     return trace, kernels
+
+
+def _bucket_rows(trace: ProgramTrace, rows) -> "_collections.OrderedDict":
+    """Group _program_kernels rows into cross-ref fusion buckets:
+    (nest index, signature digest) -> [(row index, ref index), ...].
+
+    Refs in one bucket classify under ONE compiled kernel and share
+    one draw plan (the signature pins level/structure, so highs and
+    the target sample count match), which is exactly what lets their
+    buffers stack along a leading ref axis. Ordered by first
+    appearance: per-ref seeds (cfg.seed * 1000003 + row index) and the
+    result order are those of the serial path."""
+    buckets: "_collections.OrderedDict" = _collections.OrderedDict()
+    for idx, (k, ri, ks, sig) in enumerate(rows):
+        buckets.setdefault((k, sig), []).append((idx, ri))
+    return buckets
+
+
+# Max batch-sized chunks folded into ONE fused host-path dispatch
+# (scanned on device). Bounds the stacked buffer at
+# R * _FUSED_HOST_CHUNKS * batch int64 slots per dispatch while still
+# collapsing the host path's per-chunk dispatch storm; the device-draw
+# path ships its whole bucketed buffer in one dispatch regardless,
+# exactly as the per-ref scan form always has.
+_FUSED_HOST_CHUNKS = 8
+
+
+def _host_fuse_plan(s: int, batch: int) -> tuple[int, int]:
+    """(chunks per fused host dispatch, dispatch count) for a ref with
+    s drawn samples: the chunk group grows geometrically (1, 2, 4, ...,
+    capped at _FUSED_HOST_CHUNKS) so every model/N lands on a handful
+    of compiled (R, group*batch) shapes — the same reasoning as the
+    draw buffers' geometric bucketing (draw.py::bucket_size)."""
+    n_chunks = -(-s // batch)
+    g = 1
+    while g < n_chunks and g < _FUSED_HOST_CHUNKS:
+        g *= 2
+    return g, -(-n_chunks // g)
 
 
 def warmup(
@@ -715,9 +839,13 @@ def warmup(
 
 
 def _warmup_kernels(program, machine, cfg, batch, capacity) -> None:
-    trace, kernels = _program_kernels(program, machine)
+    trace, rows = _program_kernels(program, machine)
+    if _use_fused(cfg):
+        _warmup_fused(trace, rows, cfg, batch, capacity)
+        return
     drawn_buckets: set = set()
-    for k, ri, kernel, kernel_s in kernels:
+    for k, ri, ks, sig in rows:
+        kernel, kernel_s = ks["plain"], ks["scan"]
         nt = trace.nests[k]
         highs, s = _sample_highs(nt, ri, cfg)
         if s == 0:  # no drawable points (degenerate triangular ref)
@@ -761,6 +889,65 @@ def _warmup_kernels(program, machine, cfg, batch, capacity) -> None:
         )
 
 
+def _warmup_fused(trace, rows, cfg, batch, capacity) -> None:
+    """Warm the fused path at the exact per-bucket stacked shapes a
+    subsequent fused run dispatches: (R, B) with the device draw's
+    bucketed buffer, (R, group*batch) with the host draw's chunk
+    groups. Pinned by tests/test_compile_sharing.py: a post-warmup
+    fused run adds zero jit cache entries."""
+    from .draw import (
+        _get_tri_kernel,
+        _rect_draw_kernel,
+        _rect_draw_kernel_batch,
+        plan_draw,
+    )
+
+    drawn_buckets: set = set()
+    for (k, sig), members in _bucket_rows(trace, rows).items():
+        nt = trace.nests[k]
+        ri0 = members[0][1]
+        fused = rows[members[0][0]][2]["fused"]
+        highs, s = _sample_highs(nt, ri0, cfg)
+        if s == 0:  # no drawable points (degenerate triangular ref)
+            continue
+        R = len(members)
+        ph = _pad_highs(highs)
+        rx_R = jnp.asarray([ri for _, ri in members], dtype=jnp.int64)
+        if _use_device_draw(cfg):
+            plan = plan_draw(nt, ri0, cfg, batch)
+            if plan is not None:
+                B, tri, s_plan, highs_t, excl, space_box = plan
+                if tri:
+                    jax.block_until_ready(_get_tri_kernel(
+                        nt, ri0, highs_t, excl, B
+                    )(jax.random.key(0), jnp.int64(s_plan)))
+                elif R == 1 and B not in drawn_buckets:
+                    # singleton buckets draw through the per-ref kernel
+                    drawn_buckets.add(B)
+                    jax.block_until_ready(_rect_draw_kernel(B)(
+                        jax.random.key(0), jnp.int64(space_box),
+                        jnp.int64(s_plan),
+                    ))
+                elif R > 1 and (R, B) not in drawn_buckets:
+                    drawn_buckets.add((R, B))
+                    jax.block_until_ready(_rect_draw_kernel_batch(R, B)(
+                        jnp.stack([jax.random.key(i) for i in range(R)]),
+                        jnp.int64(space_box), jnp.int64(s_plan),
+                    ))
+                dummy = jnp.zeros((R, B), dtype=jnp.int64)
+                jax.block_until_ready(fused(
+                    dummy, dummy < 0, ph, nt.vals, rx_R, capacity,
+                    B // batch,
+                ))
+                continue
+            # over-budget buckets take the host path below
+        g, _ = _host_fuse_plan(s, batch)
+        dummy = jnp.zeros((R, g * batch), dtype=jnp.int64)
+        jax.block_until_ready(fused(
+            dummy, dummy < 0, ph, nt.vals, rx_R, capacity, g
+        ))
+
+
 # Bump whenever the engine's RESULT semantics change (packing, share
 # thresholds, histogram encoding, seeded sample stream, ...): the
 # version is folded into every checkpoint tag, so stale files from an
@@ -782,6 +969,18 @@ def _use_device_draw(cfg) -> bool:
     if cfg.device_draw is None:
         return jax.default_backend() != "cpu"
     return cfg.device_draw
+
+
+def _use_fused(cfg) -> bool:
+    """Resolve cfg.fuse_refs (None = auto, same shape as device_draw):
+    cross-ref fused dispatch on accelerator backends, where every
+    dispatch pays a round trip worth amortizing; the serial per-ref
+    loop on CPU, where dispatch is cheap and the vmap-safe sorted
+    merge costs more than the dispatches it saves (see
+    SamplerConfig.fuse_refs)."""
+    if cfg.fuse_refs is None:
+        return jax.default_backend() != "cpu"
+    return cfg.fuse_refs
 
 
 def _checkpoint_tagger(program, machine, cfg, batch):
@@ -863,17 +1062,46 @@ def sampled_outputs(
     at per-ref granularity. The reference framework has no
     checkpointing (its only persisted artifact is the final MRC,
     pluss_utils.h:885-913); this goes beyond parity by design.
+
+    cfg.fuse_refs (auto: ON off-CPU) routes through the cross-ref
+    fused runner: refs sharing a kernel-signature bucket are stacked and
+    classified by one vmapped dispatch per bucket, and dispatches
+    stream through a depth-bounded async pipeline
+    (cfg.pipeline_depth). Both runners produce bit-identical results
+    — the fused path is a pure dispatch/overlap optimization, and
+    fuse_refs=False keeps the serial per-ref loop as the parity
+    oracle.
     """
     import os
 
     if batch is None:
         batch = default_batch()
-    trace, kernels = _program_kernels(program, machine)
+    trace, rows = _program_kernels(program, machine)
+    tag_of = None
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
         tag_of = _checkpoint_tagger(program, machine, cfg, batch)
+    if _use_fused(cfg):
+        return _sampled_outputs_fused(
+            trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+        )
+    return _sampled_outputs_serial(
+        trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+    )
+
+
+def _sampled_outputs_serial(
+    trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+):
+    """The legacy per-ref loop (cfg.fuse_refs=False): one dispatch
+    chain per ref, pipelined only within a ref's own host chunks. Kept
+    verbatim as the fused runner's bit-identity oracle."""
+    import os
+
+    depth = max(1, cfg.pipeline_depth)
     results = []
-    for idx, (k, ri, kernel, kernel_s) in enumerate(kernels):
+    for idx, (k, ri, ks, sig) in enumerate(rows):
+        kernel, kernel_s = ks["plain"], ks["scan"]
         nt = trace.nests[k]
         name = nt.tables.ref_names[ri]
         ck_path = ck_tag = None
@@ -966,7 +1194,10 @@ def sampled_outputs(
 
                 with telemetry.span("dispatch", form="chunk"):
                     pending.append((redo(cap), redo, cap))
-                if len(pending) >= 4:
+                if len(pending) >= depth:
+                    # the depth bound forces a synchronous drain of the
+                    # oldest in-flight dispatch before the next one
+                    telemetry.count("pipeline_stalls")
                     drain(pending.pop(0))
         for entry in pending:
             drain(entry)
@@ -979,6 +1210,256 @@ def sampled_outputs(
             _checkpoint_store(ck_path, ck_tag, result)
         results.append(result)
     return results
+
+
+def _sampled_outputs_fused(
+    trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+):
+    """Cross-ref fused, pipelined form of the sampled engine.
+
+    Structure (cfg.fuse_refs on — the off-CPU default):
+
+    - rows are grouped into kernel-signature buckets (_bucket_rows);
+      each bucket's refs draw their per-ref sample streams (unchanged
+      seeds: cfg.seed * 1000003 + row index), stack them along a
+      leading ref axis, and classify in ONE vmapped scan-fused
+      dispatch (_build_ref_kernel_fused) instead of one chain per ref;
+    - dispatches enter a GLOBAL depth-bounded async pipeline: outputs
+      start their device->host copy immediately (copy_to_host_async),
+      and while they transfer the next bucket draws and dispatches.
+      Only when the depth bound (cfg.pipeline_depth) is hit does the
+      host block on the oldest entry (counted as pipeline_stalls);
+    - the capacity-regrow drain loop runs per bucket dispatch — one
+      regrown re-dispatch covers every member, so capacity_regrows
+      counts once per bucket, not once per ref;
+    - already-checkpointed refs are masked out of their bucket's stack
+      (the bucket dispatches with fewer rows); refs whose device draw
+      falls back to the host stream form their own stacked sub-group,
+      exactly mirroring the serial path's per-ref fallback.
+
+    Results are bit-identical to _sampled_outputs_serial: same sample
+    streams, and every reduction along both paths is exact.
+
+    Telemetry: dispatches_fused / refs_fused counters, pipeline_stalls,
+    and end-of-run gauges ref_buckets, expected_chunks (max dispatches
+    any bucket planned), refs_per_dispatch, pipeline_overlap_s (summed
+    in-flight time the host spent off the critical path) —
+    tools/check_dispatch_stats.py audits `dispatches` against
+    ref_buckets * expected_chunks (+ regrows).
+    """
+    import os
+    import time
+
+    depth = max(1, cfg.pipeline_depth)
+    results: dict[int, SampledRefResult] = {}
+    pending: list = []
+    cap = capacity
+    overlap_s = 0.0
+    n_buckets = 0
+    max_bucket_dispatches = 0
+    n_fused = 0
+    n_refs_fused = 0
+
+    def finalize(idx, name, acc):
+        result = SampledRefResult(
+            name=name, noshare=acc["noshare"], share=acc["share"],
+            cold=acc["cold"], n_samples=acc["n_samples"],
+        )
+        if checkpoint_dir is not None:
+            _checkpoint_store(
+                os.path.join(checkpoint_dir, f"ref_{idx:03d}.json"),
+                tag_of(idx, name), result,
+            )
+        results[idx] = result
+
+    def drain(entry):
+        nonlocal cap, overlap_s
+        # time this dispatch spent in flight while the host worked on
+        # other buckets — the overlap the pipeline exists to buy
+        overlap_s += max(0.0, time.perf_counter() - entry["t0"])
+        mk = mc = max_nu = cold = None
+        dispatch_cap = entry["cap"]
+        with telemetry.span("fetch", fused=True):
+            mk, mc, max_nu, cold = telemetry.record_fetch(
+                jax.device_get(entry["out"])
+            )
+        while int(max_nu.max()) > dispatch_cap:
+            # rare: some member saw more distinct (reuse, class) pairs
+            # than slots — regrow ONCE for the whole bucket dispatch
+            dispatch_cap = max(dispatch_cap * 4, int(max_nu.max()))
+            cap = max(cap, dispatch_cap)
+            telemetry.count("capacity_regrows")
+            with telemetry.span("fetch", fused=True, regrow=True):
+                mk, mc, max_nu, cold = telemetry.record_fetch(
+                    jax.device_get(entry["redo"](dispatch_cap))
+                )
+        with telemetry.span("merge"):
+            for j, (idx, name, acc) in enumerate(entry["members"]):
+                acc["cold"] += float(cold[j])
+                decode_pairs(mk[j], mc[j], acc["noshare"], acc["share"])
+                acc["left"] -= 1
+                if acc["left"] == 0:
+                    finalize(idx, name, acc)
+
+    def dispatch_group(fused, mem, make_inputs, ph, nv, rx_R, n_chunks):
+        nonlocal n_fused, n_refs_fused
+
+        def redo(c2):
+            keys_RB, mask_RB = make_inputs()
+            telemetry.count("dispatches")
+            telemetry.count("dispatches_fused")
+            return fused(keys_RB, mask_RB, ph, nv, rx_R, c2, n_chunks)
+
+        with telemetry.span("dispatch", form="fused", refs=len(mem)):
+            out = redo(cap)
+        for arr in out:
+            # start the device->host transfer now, so it overlaps the
+            # next bucket's draw + dispatch; the drain's device_get
+            # then just waits instead of initiating
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        n_fused += 1
+        n_refs_fused += len(mem)
+        pending.append({
+            "out": out, "redo": redo, "cap": cap, "members": mem,
+            "t0": time.perf_counter(),
+        })
+        while len(pending) >= depth:
+            telemetry.count("pipeline_stalls")
+            drain(pending.pop(0))
+
+    for (k, sig), members_all in _bucket_rows(trace, rows).items():
+        nt = trace.nests[k]
+        names = {idx: nt.tables.ref_names[ri] for idx, ri in members_all}
+        members = []
+        for idx, ri in members_all:
+            if checkpoint_dir is not None:
+                prior = _checkpoint_load(
+                    os.path.join(checkpoint_dir, f"ref_{idx:03d}.json"),
+                    tag_of(idx, names[idx]),
+                )
+                if prior is not None:
+                    # resumed ref: masked out of the bucket's stack —
+                    # the remaining members still dispatch fused
+                    results[idx] = prior
+                    continue
+            members.append((idx, ri))
+        if not members:
+            continue
+        ri0 = members[0][1]
+        highs, s = _sample_highs(nt, ri0, cfg)
+        accs = {
+            idx: {"noshare": {}, "share": {}, "cold": 0.0,
+                  "n_samples": 0, "left": 0}
+            for idx, _ in members
+        }
+        if s == 0:  # no drawable points (degenerate triangular ref)
+            for idx, _ in members:
+                finalize(idx, names[idx], accs[idx])
+            continue
+        n_buckets += 1
+        bspan = telemetry.span(
+            "bucket", engine="sampled", nest=k,
+            refs=",".join(names[idx] for idx, _ in members),
+        )
+        bspan.__enter__()
+        drawn = None
+        if _use_device_draw(cfg):
+            from .draw import draw_bucket_keys_device
+
+            with telemetry.span("draw", where="device"):
+                drawn = draw_bucket_keys_device(
+                    nt, [ri for _, ri in members], cfg,
+                    [cfg.seed * 1000003 + idx for idx, _ in members],
+                    batch,
+                )
+        host_members = []
+        dev_groups: dict[int, list] = {}
+        if drawn is None:
+            host_members = members
+        else:
+            for (idx, ri), d in zip(members, drawn):
+                if d is None:
+                    # over the device budget: this member joins the
+                    # host stream, exactly like the serial fallback
+                    host_members.append((idx, ri))
+                    continue
+                sk, chosen, s_m, _hi = d
+                accs[idx]["n_samples"] = s_m
+                # retries can grow one member's buffer past the
+                # bucket's planned B; equal-B members stack together
+                dev_groups.setdefault(int(sk.shape[0]), []).append(
+                    (idx, ri, sk, chosen)
+                )
+        ph = _pad_highs(highs)
+        fused = rows[members[0][0]][2]["fused"]
+        bucket_dispatches = 0
+        for B, grp in dev_groups.items():
+            rx_R = jnp.asarray([ri for _, ri, _, _ in grp], jnp.int64)
+            mem = []
+            for idx, _, _, _ in grp:
+                accs[idx]["left"] += 1
+                mem.append((idx, names[idx], accs[idx]))
+
+            def make_inputs(grp=grp):
+                return (
+                    jnp.stack([sk for _, _, sk, _ in grp]),
+                    jnp.stack([ch for _, _, _, ch in grp]),
+                )
+
+            dispatch_group(
+                fused, mem, make_inputs, ph, nt.vals, rx_R, B // batch
+            )
+            bucket_dispatches += 1
+        if host_members:
+            with telemetry.span("draw", where="host"):
+                keys_list = []
+                for idx, ri in host_members:
+                    keys_all, _hi = draw_sample_keys(
+                        nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+                    )
+                    accs[idx]["n_samples"] = len(keys_all)
+                    keys_list.append(keys_all)
+            n_samples = len(keys_list[0])
+            g, n_groups = _host_fuse_plan(n_samples, batch)
+            span_len = g * batch
+            rx_R = jnp.asarray([ri for _, ri in host_members], jnp.int64)
+            mem = []
+            for idx, _ in host_members:
+                accs[idx]["left"] += n_groups
+                mem.append((idx, names[idx], accs[idx]))
+            for gi in range(n_groups):
+                lo = gi * span_len
+
+                def make_inputs(lo=lo, kl=keys_list, span_len=span_len):
+                    buf = np.empty((len(kl), span_len), dtype=np.int64)
+                    msk = np.zeros((len(kl), span_len), dtype=bool)
+                    for j, ka in enumerate(kl):
+                        seg = ka[lo:lo + span_len]
+                        buf[j, :len(seg)] = seg
+                        buf[j, len(seg):] = ka[0]  # decodable padding
+                        msk[j, :len(seg)] = True
+                    return jnp.asarray(buf), jnp.asarray(msk)
+
+                dispatch_group(
+                    fused, mem, make_inputs, ph, nt.vals, rx_R, g
+                )
+                bucket_dispatches += 1
+        bspan.__exit__(None, None, None)
+        max_bucket_dispatches = max(max_bucket_dispatches,
+                                    bucket_dispatches)
+    while pending:
+        drain(pending.pop(0))
+    telemetry.gauge("fuse_refs", 1)
+    telemetry.gauge("pipeline_depth", depth)
+    telemetry.gauge("ref_buckets", n_buckets)
+    telemetry.gauge("expected_chunks", max_bucket_dispatches)
+    telemetry.gauge("pipeline_overlap_s", overlap_s)
+    if n_fused:
+        telemetry.gauge("refs_per_dispatch", n_refs_fused / n_fused)
+    return [results[idx] for idx in range(len(rows))]
 
 
 def results_from_samples(

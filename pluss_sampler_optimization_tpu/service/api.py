@@ -73,6 +73,13 @@ class AnalysisRequest:
     ratio: float = 0.1
     seed: int = 0
     device_draw: bool | None = None
+    # Dispatch-shape knobs for the sampled engine (None = config
+    # default). Pure performance: fused results are bit-identical to
+    # the per-ref path, so — unlike device_draw — these MUST NOT
+    # enter params()/the fingerprint; a cached result answers both
+    # settings.
+    fuse_refs: bool | None = None
+    pipeline_depth: int | None = None
     deadline_s: float | None = None
     id: str | None = None
 
@@ -106,7 +113,9 @@ class AnalysisRequest:
             p["seed"] = self.seed
             # the requested selector (None = per-backend auto); the
             # two draw paths yield different deterministic sample
-            # sets, so an explicit choice must split the address
+            # sets, so an explicit choice must split the address.
+            # fuse_refs / pipeline_depth stay OUT: fused dispatch is
+            # pinned bit-identical, so they cannot shape the result
             p["device_draw"] = self.device_draw
         return p
 

@@ -116,6 +116,10 @@ def default_runner(engine: str, program: Program,
         kw = {}
         if request.device_draw is not None:
             kw["device_draw"] = request.device_draw
+        if request.fuse_refs is not None:
+            kw["fuse_refs"] = request.fuse_refs
+        if request.pipeline_depth is not None:
+            kw["pipeline_depth"] = request.pipeline_depth
         cfg = SamplerConfig(
             ratio=request.ratio, seed=request.seed, **kw
         )

@@ -120,6 +120,39 @@ def test_cross_model_sharing_is_structural_only():
     )
 
 
+def test_warmup_compiles_fused_shapes():
+    """warmup() parity with cross-ref fusion (ISSUE 6): warmup must
+    compile the fused kernels at the per-bucket STACKED shapes the
+    fused runner will dispatch — (R, group*batch) for the host path —
+    so a post-warmup fused run adds ZERO jit cache entries."""
+    import dataclasses
+
+    cfg = SamplerConfig(ratio=0.4, seed=3, fuse_refs=True)
+    kw = dict(batch=1 << 10)
+
+    def fused_compiles():
+        return sum(
+            e["fused"]._cache_size() for e in S._SIG_KERNELS.values()
+        )
+
+    S._SIG_KERNELS.clear()
+    S._program_kernels.cache_clear()
+    S.warmup(REGISTRY["gemm"](128), MACHINE, cfg, **kw)
+    after_warmup = fused_compiles()
+    assert after_warmup > 0, "warmup never touched the fused kernels"
+    st_w, _ = S.run_sampled(REGISTRY["gemm"](128), MACHINE, cfg, **kw)
+    assert fused_compiles() == after_warmup, (
+        "post-warmup fused run recompiled: warmup misses the stacked "
+        "bucket shapes"
+    )
+    # and the warmed fused run is still bit-identical to unfused
+    st_s, _ = S.run_sampled(
+        REGISTRY["gemm"](128), MACHINE,
+        dataclasses.replace(cfg, fuse_refs=False), **kw,
+    )
+    assert _state_dump(st_w) == _state_dump(st_s)
+
+
 def test_padded_highs_decode_roundtrip():
     """Padded highs (1s beyond the ref depth) decode exactly like the
     unpadded radix for keys in the ref's own space."""

@@ -181,3 +181,51 @@ def test_scan_capacity_regrow_device_draw():
         assert a.noshare == b.noshare
         assert a.share == b.share
         assert a.cold == b.cold and a.n_samples == b.n_samples
+
+
+def test_bucket_draw_matches_per_ref_draw():
+    """The vmapped bucket draw (ISSUE 6 fused dispatch) is the twin of
+    the per-ref device draw: for every member of a multi-ref bucket,
+    draw_bucket_keys_device must return the SAME sorted-key buffer and
+    selection mask, bit for bit, as draw_sample_keys_device with the
+    same seed — same threefry fold sequence, just stacked rows."""
+    from pluss_sampler_optimization_tpu.sampler import sampled as S
+
+    trace = ProgramTrace(gemm(32), MACHINE)
+    nt = trace.nests[0]
+    cfg = SamplerConfig(ratio=0.3, seed=7, device_draw=True)
+    by_sig = {}
+    for ri in range(nt.tables.n_refs):
+        by_sig.setdefault(S._kernel_sig(nt, ri), []).append(ri)
+    buckets = [m for m in by_sig.values() if len(m) >= 2]
+    assert buckets, "gemm must have at least one multi-ref bucket"
+    batch = 1 << 12
+    for members in buckets:
+        seeds = [cfg.seed * 1000003 + ri for ri in members]
+        out = D.draw_bucket_keys_device(nt, members, cfg, seeds, batch)
+        assert out is not None and len(out) == len(members)
+        for (ri, sd), got in zip(zip(members, seeds), out):
+            assert got is not None
+            ref = D.draw_sample_keys_device(
+                nt, ri, cfg, seed=sd, batch=batch
+            )
+            assert ref is not None
+            assert np.array_equal(np.asarray(got[0]), np.asarray(ref[0]))
+            assert np.array_equal(np.asarray(got[1]), np.asarray(ref[1]))
+            assert got[2] == ref[2]
+            assert tuple(got[3]) == tuple(ref[3])
+    # a singleton "bucket" routes straight to the per-ref path
+    solo = [m for m in by_sig.values() if len(m) == 1]
+    if solo:
+        ri = solo[0][0]
+        out = D.draw_bucket_keys_device(
+            nt, [ri], cfg, [cfg.seed * 1000003 + ri], batch
+        )
+        ref = D.draw_sample_keys_device(
+            nt, ri, cfg, seed=cfg.seed * 1000003 + ri, batch=batch
+        )
+        assert (out is None) == (ref is None)
+        if out is not None:
+            assert np.array_equal(
+                np.asarray(out[0][0]), np.asarray(ref[0])
+            )

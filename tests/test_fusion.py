@@ -1,0 +1,239 @@
+"""Cross-ref fused dispatch + async pipeline: the bit-identity
+contract (ISSUE 6).
+
+The fused sampled path (sampler/sampled.py::_sampled_outputs_fused)
+stacks refs sharing a kernel-signature bucket into ONE vmapped
+dispatch and overlaps device->host transfers with the next bucket's
+draw. Every one of its reductions is exact and the per-ref sample
+streams are unchanged, so fusion on vs off MUST produce the same MRC
+bytes — on rectangular and triangular models, under both draw paths,
+through capacity regrows, checkpoint resume, and the sharded engine.
+"""
+
+import dataclasses
+import glob
+import os
+
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.models import REGISTRY, gemm, syrk_tri
+from pluss_sampler_optimization_tpu.parallel import (
+    build_mesh,
+    run_sampled_sharded,
+)
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+
+MACHINE = MachineConfig()
+BASE = SamplerConfig(ratio=0.25, seed=3, fuse_refs=True)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _state_dump(state):
+    return (
+        [sorted(h.items()) for h in state.noshare],
+        [sorted((k, sorted(v.items())) for k, v in h.items())
+         for h in state.share],
+    )
+
+
+def _run(prog, cfg, **kw):
+    tele = telemetry.enable()
+    state, results = run_sampled(prog, MACHINE, cfg, **kw)
+    telemetry.disable()
+    return state, results, tele
+
+
+def _assert_identical(prog, cfg, **kw):
+    st_f, r_f, t_f = _run(prog, dataclasses.replace(cfg, fuse_refs=True),
+                          **kw)
+    st_s, r_s, t_s = _run(prog, dataclasses.replace(cfg, fuse_refs=False),
+                          **kw)
+    assert _state_dump(st_f) == _state_dump(st_s)
+    assert len(r_f) == len(r_s)
+    for a, b in zip(r_f, r_s):
+        assert a == b  # full SampledRefResult equality, field by field
+    return t_f, t_s
+
+
+@pytest.mark.parametrize("device_draw", [False, True])
+def test_fused_bit_identical_gemm(device_draw):
+    """The headline contract on the headline model: fusion on vs off,
+    same MRC bytes — and fewer dispatches with fusion on."""
+    cfg = dataclasses.replace(BASE, device_draw=device_draw)
+    t_f, t_s = _assert_identical(gemm(16), cfg)
+    assert t_f.counters["dispatches"] < t_s.counters["dispatches"]
+    assert t_f.counters["dispatches_fused"] >= 1
+    assert "dispatches_fused" not in t_s.counters
+    # the bucket plan the dispatch-stats checker audits
+    assert t_f.gauges["ref_buckets"] == 4  # {C0,C1} {C2,C3} {A0} {B0}
+    assert t_f.gauges["refs_per_dispatch"] == pytest.approx(1.5)
+    assert (
+        t_f.counters["dispatches"]
+        <= t_f.gauges["ref_buckets"] * t_f.gauges["expected_chunks"]
+        + t_f.counters.get("capacity_regrows", 0)
+    )
+
+
+def test_fused_bit_identical_triangular():
+    """Triangular refs land in singleton buckets (their signatures pin
+    ref_idx), so fusion must degrade gracefully to the per-ref kernels
+    there — still bit-identical, still counted against the plan."""
+    t_f, _t_s = _assert_identical(syrk_tri(12), BASE)
+    assert (
+        t_f.counters["dispatches"]
+        <= t_f.gauges["ref_buckets"] * t_f.gauges["expected_chunks"]
+        + t_f.counters.get("capacity_regrows", 0)
+    )
+
+
+def test_capacity_regrow_under_fusion():
+    """Force regrows with capacity=1 and pin that (a) the regrown
+    fused dispatch is bit-identical to the serial regrow path and (b)
+    capacity_regrows counts once per regrown BUCKET dispatch, not once
+    per ref. jacobi-2d is the probe: its five stencil reads of A share
+    ONE bucket, and two of them individually hold >1 distinct
+    (reuse, class) pairs — the serial path regrows each of those refs
+    (2 counts), the fused path regrows their shared bucket dispatch
+    exactly once."""
+    cfg = dataclasses.replace(BASE, ratio=0.4, seed=11)
+    prog = REGISTRY["jacobi-2d"](16)
+    # establish how many refs individually exceed capacity 1
+    _, r_big, _ = _run(prog, cfg, capacity=4096)
+    n_overflowing = sum(
+        1 for r in r_big
+        if len(r.noshare) + sum(len(h) for h in r.share.values()) > 1
+    )
+    assert n_overflowing >= 2
+    st_f, r_f, t_f = _run(prog, cfg, capacity=1)
+    st_s, r_s, t_s = _run(
+        prog, dataclasses.replace(cfg, fuse_refs=False), capacity=1
+    )
+    # the regrown fused run matches the serial regrow path AND the
+    # amply-provisioned run, ref by ref
+    assert _state_dump(st_f) == _state_dump(st_s)
+    for a, b, c in zip(r_f, r_s, r_big):
+        assert a == b
+        assert a == c
+    assert t_f.counters["capacity_regrows"] >= 1
+    # once per regrown bucket dispatch: strictly fewer counts than
+    # overflowing refs (a per-ref accounting would reach at least
+    # n_overflowing, which is what the serial loop records)
+    assert t_f.counters["capacity_regrows"] < n_overflowing
+    assert (
+        t_f.counters["capacity_regrows"]
+        < t_s.counters["capacity_regrows"]
+    )
+    # and the regrown run still satisfies the dispatch-plan bound
+    assert (
+        t_f.counters["dispatches"]
+        <= t_f.gauges["ref_buckets"] * t_f.gauges["expected_chunks"]
+        + t_f.counters["capacity_regrows"]
+    )
+
+
+def test_resume_mid_bucket_masks_checkpointed_refs(tmp_path):
+    """Checkpoint resume composes with fusion: a bucket whose OTHER
+    member already checkpointed re-dispatches with the finished ref
+    masked out of the stack (fewer rows, same kernel) — and the
+    resumed run's output is byte-identical to the uninterrupted one."""
+    ckpt = str(tmp_path / "ck")
+    os.makedirs(ckpt)
+    st_full, r_full, _ = _run(gemm(16), BASE, checkpoint_dir=ckpt)
+    files = sorted(glob.glob(os.path.join(ckpt, "ref_*.json")))
+    assert len(files) == len(r_full) == 6
+    # kill ref 1 (C1) — the second member of the first {C0, C1}
+    # bucket; C0's checkpoint survives, so the bucket resumes with a
+    # single-row stack
+    os.remove(os.path.join(ckpt, "ref_001.json"))
+    st_res, r_res, t_res = _run(gemm(16), BASE, checkpoint_dir=ckpt)
+    assert _state_dump(st_res) == _state_dump(st_full)
+    for a, b in zip(r_res, r_full):
+        assert a == b
+    # only the one de-checkpointed ref recomputed, alone in its bucket
+    assert t_res.gauges["ref_buckets"] == 1
+    assert t_res.gauges["refs_per_dispatch"] == pytest.approx(1.0)
+    # and a fully-checkpointed rerun dispatches nothing at all
+    _st, r_all, t_all = _run(gemm(16), BASE, checkpoint_dir=ckpt)
+    for a, b in zip(r_all, r_full):
+        assert a == b
+    assert "dispatches" not in t_all.counters
+    assert t_all.gauges["ref_buckets"] == 0
+
+
+def test_pipeline_depth_knob_and_stalls():
+    """--pipeline-depth bounds the in-flight dispatches; a depth-1
+    pipeline drains after every dispatch (a stall per dispatch) yet
+    results stay bit-identical; deeper pipelines stall less."""
+    d1 = dataclasses.replace(BASE, pipeline_depth=1)
+    st_1, r_1, t_1 = _run(gemm(16), d1)
+    st_4, r_4, t_4 = _run(gemm(16), BASE)  # default depth 4
+    assert _state_dump(st_1) == _state_dump(st_4)
+    for a, b in zip(r_1, r_4):
+        assert a == b
+    assert t_1.counters["pipeline_stalls"] == t_1.counters["dispatches"]
+    assert (
+        t_4.counters.get("pipeline_stalls", 0)
+        < t_1.counters["pipeline_stalls"]
+    )
+    assert t_1.gauges["pipeline_depth"] == 1
+    assert t_4.gauges["pipeline_depth"] == 4
+    # the serial (unfused) host path honors the same knob
+    s1 = dataclasses.replace(BASE, fuse_refs=False, pipeline_depth=1)
+    st_s1, _r, t_s1 = _run(gemm(64), s1)
+    st_s4, _r, _ = _run(gemm(64), dataclasses.replace(
+        BASE, fuse_refs=False))
+    assert _state_dump(st_s1) == _state_dump(st_s4)
+    assert t_s1.counters.get("pipeline_stalls", 0) >= 1
+
+
+@pytest.mark.parametrize("device_draw", [False, True])
+def test_sharded_fusion_bit_identical(device_draw):
+    """The sharded engine's fused bucket path must match its own
+    per-ref loop under both draw streams on the 8-device virtual mesh.
+    (Equality with the unsharded engine follows transitively: the
+    sharded serial loop is pinned against the unsharded serial loop in
+    test_parallel, and unsharded fused-vs-serial in the tests above.)
+    """
+    mesh = build_mesh(8)
+    cfg = dataclasses.replace(BASE, device_draw=device_draw)
+    _, sh_f = run_sampled_sharded(
+        gemm(16), MACHINE, dataclasses.replace(cfg, fuse_refs=True),
+        mesh=mesh,
+    )
+    _, sh_s = run_sampled_sharded(
+        gemm(16), MACHINE, dataclasses.replace(cfg, fuse_refs=False),
+        mesh=mesh,
+    )
+    for a, b in zip(sh_f, sh_s):
+        assert a == b
+
+
+@pytest.mark.slow
+def test_sharded_fused_capacity_regrow():
+    """Bucket-grain regrow on the mesh: capacity 1 forces the fused
+    sharded drain loop to regrow and re-dispatch whole buckets; the
+    result must still match the amply-provisioned unsharded engine."""
+    mesh = build_mesh(8)
+    cfg = dataclasses.replace(BASE, ratio=0.4, seed=11)
+    tele = telemetry.enable()
+    _, small = run_sampled_sharded(
+        gemm(16), MACHINE, cfg, mesh=mesh, capacity=1
+    )
+    telemetry.disable()
+    _, big = run_sampled(gemm(16), MACHINE, cfg, capacity=4096)
+    for a, b in zip(small, big):
+        assert a == b
+    assert tele.counters["capacity_regrows"] >= 1
+    assert (
+        tele.counters["dispatches"]
+        <= tele.gauges["ref_buckets"] * tele.gauges["expected_chunks"]
+        + tele.counters["capacity_regrows"]
+    )

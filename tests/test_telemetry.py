@@ -19,6 +19,7 @@ sys.path.insert(
     os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "tools"),
 )
+import check_dispatch_stats  # noqa: E402
 import check_telemetry_schema  # noqa: E402
 
 MACHINE = MachineConfig()
@@ -107,6 +108,48 @@ def test_counters_and_monitoring_under_real_dispatch():
     telemetry.disable()
     jd2 = tele2.jax_delta()
     assert sum(jd2["events"].values()) == 0
+
+
+def test_check_dispatch_stats_tool(tmp_path):
+    """tools/check_dispatch_stats.py audits a fused sampled run's
+    dispatch count against its exported bucket plan — a REAL fused run
+    passes, an inflated dispatch counter (a silent fusion regression)
+    fails, and unfused documents are skipped unless --require-fused."""
+    from pluss_sampler_optimization_tpu import SamplerConfig
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        run_sampled,
+    )
+
+    tele = telemetry.enable()
+    run_sampled(REGISTRY["gemm"](16), MACHINE,
+                SamplerConfig(ratio=0.25, seed=3, fuse_refs=True))
+    telemetry.disable()
+    path = str(tmp_path / "fused.json")
+    tele.write_json(path)
+    assert check_dispatch_stats.main([path]) == 0
+
+    with open(path) as f:
+        doc = json.load(f)
+    error, note = check_dispatch_stats.check(doc)
+    assert error is None and "buckets" in note
+    # a regression: per-ref dispatching sneaking back in
+    doc["counters"]["dispatches"] = (
+        doc["gauges"]["ref_buckets"] * doc["gauges"]["expected_chunks"]
+        + doc["counters"].get("capacity_regrows", 0) + 1
+    )
+    bad = str(tmp_path / "regressed.json")
+    with open(bad, "w") as f:
+        json.dump(doc, f)
+    assert check_dispatch_stats.main([bad]) == 1
+    # unfused runs export no bucket gauges: skipped by default,
+    # rejected under --require-fused (the bench sidecar contract)
+    del doc["gauges"]["ref_buckets"]
+    unfused = str(tmp_path / "unfused.json")
+    with open(unfused, "w") as f:
+        json.dump(doc, f)
+    assert check_dispatch_stats.main([unfused]) == 0
+    assert check_dispatch_stats.main(["--require-fused", unfused]) == 1
+    assert check_dispatch_stats.main([str(tmp_path / "absent.json")]) == 1
 
 
 def test_json_schema_roundtrip(tmp_path):

@@ -1,0 +1,103 @@
+"""Audit a sampled run's dispatch economics from its telemetry JSON;
+exit nonzero when cross-ref fusion silently regressed.
+
+The fused sampled engine (pluss_sampler_optimization_tpu/sampler/
+sampled.py::_sampled_outputs_fused and the sharded twin) promises one
+dispatch per kernel-signature bucket per chunk group, and exports the
+plan as gauges: `ref_buckets` (buckets that dispatched) and
+`expected_chunks` (the largest per-bucket dispatch count). A fusion
+regression — refs falling out of their bucket, a chunk plan
+fragmenting — shows up as `dispatches` exceeding the bucket plan's
+ceiling, long before any wall-time benchmark notices. This checker is
+the contract's enforcement point:
+
+    dispatches <= ref_buckets * expected_chunks + capacity_regrows
+
+(each capacity regrow legitimately re-runs one bucket dispatch).
+Exercised from the test suite (tests/test_telemetry.py) like the other
+check_* tools, so tier-1 catches regressions.
+
+    python tools/check_dispatch_stats.py TELEMETRY.json [more.json ...]
+
+Documents without the fusion gauges (unfused runs, other engines) are
+skipped by default; pass --require-fused to fail on them instead —
+the bench sidecar for a --fuse-refs run should never lack the gauges.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def check(doc) -> tuple[str | None, str | None]:
+    """(error, note) for one parsed telemetry document. error=None
+    means the document passes; note=None means nothing to report.
+    Single source of truth for the tool AND the tests."""
+    if not isinstance(doc, dict):
+        return "document is not a JSON object", None
+    counters = doc.get("counters")
+    gauges = doc.get("gauges")
+    if not isinstance(counters, dict) or not isinstance(gauges, dict):
+        return "missing counters/gauges objects", None
+    buckets = gauges.get("ref_buckets")
+    chunks = gauges.get("expected_chunks")
+    if buckets is None or chunks is None:
+        return None, "no fusion gauges (unfused run?) — skipped"
+    dispatches = counters.get("dispatches", 0)
+    regrows = counters.get("capacity_regrows", 0)
+    bound = buckets * chunks + regrows
+    if dispatches > bound:
+        return (
+            f"dispatches {dispatches:g} exceed the bucket plan's "
+            f"ceiling {bound:g} (ref_buckets {buckets:g} * "
+            f"expected_chunks {chunks:g} + capacity_regrows "
+            f"{regrows:g}) — cross-ref fusion regressed",
+            None,
+        )
+    return None, (
+        f"dispatches {dispatches:g} <= {bound:g} "
+        f"({buckets:g} buckets * {chunks:g} chunks + {regrows:g} "
+        "regrows)"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="+", help="telemetry JSON file(s)")
+    ap.add_argument(
+        "--require-fused", action="store_true",
+        help="fail documents that lack the fusion gauges instead of "
+        "skipping them",
+    )
+    args = ap.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"{path}: unreadable ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        error, note = check(doc)
+        if error is None and note and "skipped" in note and (
+            args.require_fused
+        ):
+            error, note = f"{note} but --require-fused is set", None
+        if error:
+            rc = 1
+            print(f"{path}: {error}", file=sys.stderr)
+        else:
+            print(f"{path}: OK ({note})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
