@@ -1111,6 +1111,104 @@ def main() -> int:
         except Exception as e:  # never sink the headline metric
             sc["error"] = repr(e)
 
+    # Cross-request continuous batching: K=4 concurrent DISTINCT
+    # sampled requests served twice from cold caches — without a batch
+    # window (K solo engine executions) and with one (requests merge
+    # into a single union-bucket fused dispatch plan). Dispatch and
+    # execution counts come from the live telemetry; bit-identity is
+    # asserted on the per-request MRC digests (the batching contract:
+    # a member's MRC must match its solo run byte for byte).
+    if extras_budget_left("cross_request_batching", extra):
+        cb: dict = {}
+        extra["cross_request_batching"] = cb
+        try:
+            import shutil
+            import tempfile
+
+            from pluss_sampler_optimization_tpu.service import (
+                AnalysisRequest,
+                AnalysisService,
+            )
+
+            reqs = [
+                AnalysisRequest(model="gemm", n=24, engine="sampled",
+                                ratio=0.2, seed=11),
+                AnalysisRequest(model="gemm", n=32, engine="sampled",
+                                ratio=0.2, seed=12),
+                AnalysisRequest(model="2mm", n=12, engine="sampled",
+                                ratio=0.2, seed=13),
+                AnalysisRequest(model="mvt", n=48, engine="sampled",
+                                ratio=0.2, seed=14),
+            ]
+            cb["requests"] = [
+                {"model": r.model, "n": r.n, "seed": r.seed}
+                for r in reqs
+            ]
+            digests: dict = {}
+            for label, window in (("unbatched", None),
+                                  ("batched", 250.0)):
+                svc_dir = tempfile.mkdtemp(
+                    prefix=f"bench_batching_{label}_"
+                )
+                try:
+                    d0 = tele.counters.get("dispatches", 0)
+                    e0 = tele.counters.get("service_exec_started", 0)
+                    b0 = tele.counters.get("batches_formed", 0)
+                    m0 = tele.counters.get("batch_members", 0)
+                    t0 = time.perf_counter()
+                    with AnalysisService(
+                        max_workers=4, cache_dir=svc_dir,
+                        batch_window_ms=window,
+                    ) as svc:
+                        tickets = [svc.submit(r) for r in reqs]
+                        resps = [svc.result(t, timeout=600)
+                                 for t in tickets]
+                    dt = time.perf_counter() - t0
+                    digests[label] = [r.mrc_digest for r in resps]
+                    cb[label] = {
+                        "wall_s": round(dt, 4),
+                        "dispatches": int(
+                            tele.counters.get("dispatches", 0) - d0
+                        ),
+                        "executions": int(
+                            tele.counters.get(
+                                "service_exec_started", 0
+                            ) - e0
+                        ),
+                        "ok": all(r.ok for r in resps),
+                    }
+                    if window is not None:
+                        cb[label]["batch_window_ms"] = window
+                        cb[label]["batches_formed"] = int(
+                            tele.counters.get("batches_formed", 0)
+                            - b0
+                        )
+                        cb[label]["batch_members"] = int(
+                            tele.counters.get("batch_members", 0)
+                            - m0
+                        )
+                        cb[label]["ref_buckets_union"] = (
+                            tele.gauges.get("ref_buckets_union")
+                        )
+                finally:
+                    shutil.rmtree(svc_dir, ignore_errors=True)
+            # the acceptance evidence: K merged requests must cost
+            # strictly fewer dispatches than K solo runs, with every
+            # member's MRC digest unchanged
+            cb["bit_identical"] = (
+                digests["unbatched"] == digests["batched"]
+            )
+            cb["dispatch_delta"] = (
+                cb["unbatched"]["dispatches"]
+                - cb["batched"]["dispatches"]
+            )
+            cb["speedup"] = round(
+                cb["unbatched"]["wall_s"]
+                / max(1e-9, cb["batched"]["wall_s"]), 2,
+            )
+        except Exception as e:  # never sink the headline metric
+            cb["error"] = repr(e)
+
     if have_counters and "compile_cache" in extra:
         # final snapshot: the extras (periodic_exact, second model) may
         # have compiled too; "total" must mean the whole process

@@ -377,6 +377,28 @@ def main(argv=None) -> int:
         help="serve mode: concurrent request executions (bounded "
         "pool; identical in-flight requests coalesce regardless)",
     )
+    ap.add_argument(
+        "--batch-window-ms",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="service-routed runs (--cache-dir / serve mode): hold "
+        "compatible concurrent sampled requests in an admission "
+        "window up to MS milliseconds and run each flushed window as "
+        "ONE batched engine execution over the union of their kernel "
+        "buckets. Every member's MRC stays bit-identical to its solo "
+        "run, so this is a pure latency-for-throughput knob (default: "
+        "off). See README \"Cross-request batching\".",
+    )
+    ap.add_argument(
+        "--batch-max-refs",
+        type=int,
+        default=64,
+        metavar="N",
+        help="with --batch-window-ms: flush a forming batch early "
+        "once its summed tracked-ref count reaches N; overflow "
+        "requests start the next batch (default: 64)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_models:
@@ -478,6 +500,11 @@ def main(argv=None) -> int:
             "--cache-dir (or serve mode, where each request line "
             "carries its own deadline_s)"
         )
+    if args.batch_window_ms is not None and not args.cache_dir:
+        raise SystemExit(
+            "--batch-window-ms batches service-routed requests; it "
+            "needs --cache-dir (or serve mode)"
+        )
 
     return _observed(
         args, lambda: _execute(args, machine, program, engine)
@@ -569,6 +596,8 @@ def _serve(args) -> int:
         with AnalysisService(
             cache_dir=args.cache_dir, max_workers=args.max_workers,
             ledger_path=args.ledger,
+            batch_window_ms=args.batch_window_ms,
+            batch_max_refs=args.batch_max_refs,
         ) as svc:
             failures = serve_jsonl(svc, fin, fout)
     finally:
@@ -593,7 +622,9 @@ def _execute_via_service(args, machine, program, engine) -> int:
 
     request = _request_from_args(args, engine)
     with AnalysisService(
-        cache_dir=args.cache_dir, ledger_path=args.ledger
+        cache_dir=args.cache_dir, ledger_path=args.ledger,
+        batch_window_ms=args.batch_window_ms,
+        batch_max_refs=args.batch_max_refs,
     ) as svc:
         if args.mode == "speed":
             times = []

@@ -139,4 +139,33 @@ class SamplerConfig:
         return max(1, min(int(math.ceil(prod)), space))
 
 
+@dataclasses.dataclass(frozen=True)
+class BatchConfig:
+    """Admission-window parameters of the service's cross-request
+    batching scheduler (service/executor.py::BatchScheduler).
+
+    Pure scheduling knobs: batching changes WHICH dispatches run, never
+    what any member computes — every member's MRC is bit-identical to
+    its solo run (sampler/sampled.py::sampled_outputs_multi), so like
+    fuse_refs/pipeline_depth these stay OUT of the request fingerprint.
+
+    Attributes:
+      window_ms: how long the first request of a forming batch may wait
+        for compatible companions before the batch flushes. 0 still
+        batches whatever arrived together but never waits.
+      max_refs: flush early once the batch's summed tracked-ref count
+        reaches this bound; a later overflow request starts the next
+        batch (overflow splitting).
+    """
+
+    window_ms: float = 5.0
+    max_refs: int = 64
+
+    def __post_init__(self) -> None:
+        if self.window_ms < 0:
+            raise ValueError("window_ms must be >= 0")
+        if self.max_refs < 1:
+            raise ValueError("max_refs must be >= 1")
+
+
 DEFAULT_MACHINE = MachineConfig()

@@ -186,11 +186,27 @@ def cri_distribute(
     rih: Optional[Hist] = None,
 ) -> Hist:
     """`pluss_cri_distribute` (pluss_utils.h:1204-1208): noshare NBD
-    spread + share racetrack, both into the global RI histogram."""
+    spread + share racetrack, both into the global RI histogram.
+
+    The merged histograms are iterated in sorted-key order. The
+    reference iterates an unordered_map (no meaningful order), but
+    float accumulation into the shared rih bins is not associative, so
+    insertion-order iteration would make the MRC depend on which
+    dispatch path built the state (serial per-ref, fused, sharded, or
+    the cross-request batched runner — each decodes pairs in a
+    different order). Canonical order makes the output a pure function
+    of histogram CONTENT, which is what the batched-vs-solo
+    bit-identity contract (tests/test_batching.py) pins.
+    """
     if rih is None:
         rih = {}
-    noshare_distribute(state.merged_noshare(), rih, thread_cnt, thread_num)
-    racetrack(state.merged_share(), rih, thread_cnt, thread_num)
+    merged = dict(sorted(state.merged_noshare().items()))
+    share = {
+        ratio: dict(sorted(h.items()))
+        for ratio, h in sorted(state.merged_share().items())
+    }
+    noshare_distribute(merged, rih, thread_cnt, thread_num)
+    racetrack(share, rih, thread_cnt, thread_num)
     return rih
 
 

@@ -22,7 +22,10 @@ service/cache.py::validate_record):
   engine is service-addressable, so direct and served executions of
   the same request join on one key), optional `compile_delta`
   (nonzero jax compile-counter movement during the execution) and
-  `mrc_digest`;
+  `mrc_digest`, and — for members of a cross-request batched
+  execution — `batch_id`/`batch_members`, so joined executions stay
+  auditable (the `stats` aggregate rolls them into batch occupancy
+  and batched-vs-solo latency);
 - kind "drift" (runtime/obs/drift.py): the sampled-vs-exact MRC error
   metrics (`max_abs_delta` / `mean_abs_delta`) and the `breach` flag;
 - kind "bench" (bench.py): the headline `metric`/`value` plus the same
@@ -135,6 +138,12 @@ def validate_row(row) -> list[str]:
             row["compile_delta"], dict
         ):
             errors.append("'compile_delta' must be an object")
+        # batched executions join on these (service/executor.py):
+        # optional — solo rows simply omit them
+        if "batch_id" in row:
+            need_str("batch_id", nullable=True)
+        if "batch_members" in row:
+            need_num("batch_members", nullable=True)
     elif kind == "drift":
         need_str("model")
         need_num("n")
@@ -229,10 +238,27 @@ def aggregate(rows: list[dict]) -> dict:
     drift: dict = {}
     bench = 0
     by_kind: dict = {}
+    batches: dict = {}
+    lat_batched: list[float] = []
+    lat_solo: list[float] = []
     for row in rows:
         kind = row["kind"]
         by_kind[kind] = by_kind.get(kind, 0) + 1
         if kind == "request":
+            bid = row.get("batch_id")
+            if bid is not None:
+                b = batches.setdefault(bid, {"rows": 0, "members": 0})
+                b["rows"] += 1
+                b["members"] = max(
+                    b["members"], int(row.get("batch_members") or 0)
+                )
+            # cold executions only: warm tiers would swamp the
+            # batched-vs-solo latency comparison
+            if row["ok"] and row.get("cache") == "miss" and (
+                row.get("latency_s") is not None
+            ):
+                (lat_batched if bid is not None
+                 else lat_solo).append(float(row["latency_s"]))
             eng = row["engine_requested"]
             agg = requests.setdefault(eng, {
                 "count": 0, "ok": 0, "failed": 0, "degraded": 0,
@@ -264,6 +290,21 @@ def aggregate(rows: list[dict]) -> dict:
         agg["cache_hit_rate"] = (
             round(warm / served, 3) if served else None
         )
+    occupancy = sorted(
+        max(b["rows"], b["members"]) for b in batches.values()
+    )
+    lat_batched.sort()
+    lat_solo.sort()
+    batching = {
+        "batches": len(batches),
+        "batched_requests": sum(b["rows"] for b in batches.values()),
+        "occupancy_p50": _percentile(occupancy, 0.50),
+        "occupancy_p95": _percentile(occupancy, 0.95),
+        "batched_p50_latency_s": round(
+            _percentile(lat_batched, 0.50), 6
+        ),
+        "solo_p50_latency_s": round(_percentile(lat_solo, 0.50), 6),
+    }
     return {
         "rows": len(rows),
         "by_kind": by_kind,
@@ -272,6 +313,7 @@ def aggregate(rows: list[dict]) -> dict:
             drift[k] for k in sorted(drift, key=lambda k: (k[0], k[1]))
         ],
         "bench_rows": bench,
+        "batching": batching,
     }
 
 
@@ -311,6 +353,16 @@ def format_stats(agg: dict) -> list[str]:
                 row["model"], row["n"], row["max_abs_delta"],
                 row["mean_abs_delta"],
                 "BREACH" if row["breach"] else "ok",
+            )
+        )
+    b = agg.get("batching")
+    if b and b["batches"]:
+        lines.append(
+            "batching: %d batches, %d member rows, occupancy "
+            "p50=%g p95=%g, cold p50 batched=%.4fs solo=%.4fs" % (
+                b["batches"], b["batched_requests"],
+                b["occupancy_p50"], b["occupancy_p95"],
+                b["batched_p50_latency_s"], b["solo_p50_latency_s"],
             )
         )
     if agg["bench_rows"]:

@@ -24,6 +24,15 @@ boxes between dispatch and fetch. This module is the shared spine:
   describe the bucket plan — tools/check_dispatch_stats.py audits
   `dispatches <= ref_buckets * expected_chunks + capacity_regrows`
   from an exported run to catch silent fusion regressions.
+  The service's cross-request batching extends the contract:
+  `batches_formed` / `batch_members` count admission-window flushes
+  and their member totals, `dispatches_batched` marks dispatches that
+  carried rows from several requests, the `batch_occupancy` /
+  `batch_queue_depth` gauges track the scheduler, `batch_jobs` +
+  `ref_buckets_union` describe the union bucket plan (the checker
+  prefers `ref_buckets_union` for its bound when present), and
+  `service_batch_failed` / `service_batch_fallback_solo` count
+  batch-level failures and members degraded to solo execution.
 - **jax.monitoring capture.** A process-global listener pair
   (registered once — jax listeners cannot be unregistered) accumulates
   EVERY monitoring event count and duration by key; each `Telemetry`

@@ -239,6 +239,85 @@ def draw_bucket_keys_device(nt, ref_indices, cfg, seeds, batch: int):
     return out
 
 
+@telemetry.counted_lru_cache(maxsize=32)
+def _rect_draw_kernel_batch_multi(R: int, B: int):
+    """Cross-request form of _rect_draw_kernel_batch: rows may come
+    from DIFFERENT programs/configs, so space and s are per-row
+    operands instead of shared scalars. Each row's bits are still the
+    per-ref kernel's (threefry is counter-based per key; the row's own
+    space/s feed the same randint/thin as its solo call)."""
+
+    @jax.jit
+    def draw(rng_keys, spaces, ss):
+        return jax.vmap(
+            _rect_draw_body, in_axes=(0, 0, 0, None)
+        )(rng_keys, spaces, ss, B)
+
+    return draw
+
+
+def draw_bucket_keys_device_multi(entries, batch: int):
+    """Device draw for one cross-request UNION bucket.
+
+    `entries` is [(nt, ref_idx, cfg, seed)] — members of one
+    signature bucket that may span several programs and sampler
+    configs, so unlike draw_bucket_keys_device they do NOT share a
+    draw plan: each member plans with its own nest/config, and only
+    members whose plans land on the same buffer size B stack into one
+    vmapped dispatch (per-row space/s operands). Triangular members
+    and singleton groups take the per-ref kernel.
+
+    Returns a list parallel to entries of (keys (B,), chosen (B,), s,
+    highs) — None for members off the device path (caller routes them
+    to the host draw). Bit-identity: a member's group is keyed by ITS
+    OWN planned B, its row consumes its own folded base key and
+    space/s, and threefry rows are counter-per-key — so every member's
+    buffer equals its solo draw_sample_keys_device attempt 0, with the
+    shortfall replay running the identical per-ref retry loop.
+    """
+    out: list = [None] * len(entries)
+    rect: dict[int, list] = {}
+    for i, (nt, ri, cfg, sd) in enumerate(entries):
+        plan = plan_draw(nt, ri, cfg, batch)
+        if plan is None:
+            continue
+        B, tri, s, highs, excl, space_box = plan
+        if tri:
+            out[i] = draw_sample_keys_device(
+                nt, ri, cfg, seed=sd, batch=batch
+            )
+            continue
+        rect.setdefault(B, []).append((i, s, highs, space_box, sd))
+    for B, grp in rect.items():
+        if len(grp) == 1:
+            i, s, highs, space_box, sd = grp[0]
+            nt, ri, cfg, _sd = entries[i]
+            out[i] = draw_sample_keys_device(
+                nt, ri, cfg, seed=sd, batch=batch
+            )
+            continue
+        bases = jnp.stack(
+            [jr.fold_in(_draw_base_key(sd), 0)
+             for _i, _s, _h, _sp, sd in grp]
+        )
+        spaces = jnp.asarray(
+            [sp for _i, _s, _h, sp, _sd in grp], jnp.int64
+        )
+        ss = jnp.asarray([s for _i, s, _h, _sp, _sd in grp], jnp.int64)
+        kern = _rect_draw_kernel_batch_multi(len(grp), B)
+        sk, chosen, U, n_chosen = kern(bases, spaces, ss)
+        Uh, nh = np.asarray(U), np.asarray(n_chosen)
+        for j, (i, s, highs, _sp, sd) in enumerate(grp):
+            if int(Uh[j]) >= s and int(nh[j]) == s:
+                out[i] = (sk[j], chosen[j], s, highs)
+            else:
+                nt, ri, cfg, _sd = entries[i]
+                out[i] = draw_sample_keys_device(
+                    nt, ri, cfg, seed=sd, batch=batch
+                )
+    return out
+
+
 def _build_tri_draw_kernel(nt, ref_idx: int, highs: tuple, excl: int, B: int):
     """Box-draw + rejection for one triangular ref (per-nest geometry
     lives in the closure, so these compile per ref)."""

@@ -455,6 +455,7 @@ def _kernels_for(nt: NestTrace, ref_idx: int, digest: str | None = None):
             "plain": _build_ref_kernel(nt, ref_idx),
             "scan": _build_ref_kernel_scan(nt, ref_idx),
             "fused": _build_ref_kernel_fused(nt, ref_idx),
+            "fused_multi": _build_ref_kernel_fused_multi(nt, ref_idx),
             "masked": _build_ref_kernel_masked(nt, ref_idx),
             "raw": _build_ref_kernel_raw(nt, ref_idx),
         },
@@ -636,6 +637,76 @@ def _build_ref_kernel_fused(nt: NestTrace, ref_idx: int):
     return kernel
 
 
+def _build_ref_kernel_fused_multi(nt: NestTrace, ref_idx: int):
+    """Cross-REQUEST twin of _build_ref_kernel_fused: one vmapped scan
+    dispatch over rows drawn from DIFFERENT programs/machines that
+    share this kernel signature.
+
+    Where the single-program fused kernel broadcasts one (highs, vals)
+    pair across the stacked rows, here each row carries its own:
+    highs_R is the (R, MAX_DEPTH) stacked radix operand and vals_R the
+    leading-axis-stacked value overlay. The signature contract
+    (_kernel_sig: "every concrete value the traced code reads from the
+    nest rather than from nt.vals MUST appear here") is what makes
+    this sound — equal signatures guarantee equal vals leaf shapes, so
+    numeric differences between requests (trips, coeffs, thresholds;
+    e.g. gemm N=256 vs N=4096, or gemm and 2mm rows whose nests lower
+    to one signature) ride entirely in the per-row operands. The scan
+    body per row is the one each member would run solo, so the batched
+    dispatch stays exact at the decoded-pair level.
+    """
+    check_packed_ratios(nt)
+    donate = () if jax.default_backend() == "cpu" else (0, 1)
+
+    @functools.partial(
+        jax.jit, static_argnames=("capacity", "n_chunks"),
+        donate_argnums=donate,
+    )
+    def kernel(keys_RB, mask_RB, highs_R, vals_R, rx_R, capacity: int,
+               n_chunks: int):
+
+        def one_ref(keys_B, mask_B, highs, vals, rx):
+            snt = nt.with_vals(vals)
+            kb = keys_B.reshape(n_chunks, -1)
+            mb = mask_B.reshape(n_chunks, -1)
+
+            def step(carry, xm):
+                ck, cc, cold, max_nu = carry
+                x, msk = xm
+                samples = decode_sample_keys(x, highs)
+                packed, _, _, found = classify_samples(
+                    snt, ref_idx, samples, rx
+                )
+                k2, c2, nu = sorted_k_unique(
+                    packed, found & msk, capacity
+                )
+                w = jnp.concatenate([cc, c2])
+                mk, mc, mnu = sorted_k_unique(
+                    jnp.concatenate([ck, k2]), w > 0, capacity,
+                    weights=w,
+                )
+                cold = cold + jnp.sum((~found & msk).astype(jnp.int64))
+                max_nu = jnp.maximum(max_nu, jnp.maximum(nu, mnu))
+                return (mk, mc, cold, max_nu), None
+
+            init = (
+                jnp.full(capacity, -1, dtype=jnp.int64),
+                jnp.zeros(capacity, dtype=jnp.int64),
+                jnp.int64(0),
+                jnp.int64(0),
+            )
+            (mk, mc, cold, max_nu), _ = jax.lax.scan(
+                step, init, (kb, mb)
+            )
+            return mk, mc, max_nu, cold
+
+        return jax.vmap(one_ref, in_axes=(0, 0, 0, 0, 0))(
+            keys_RB, mask_RB, highs_R, vals_R, rx_R
+        )
+
+    return kernel
+
+
 def _build_ref_kernel_masked(nt: NestTrace, ref_idx: int):
     """Masked twin of _build_ref_kernel for device-drawn samples.
 
@@ -792,6 +863,39 @@ def _bucket_rows(trace: ProgramTrace, rows) -> "_collections.OrderedDict":
     for idx, (k, ri, ks, sig) in enumerate(rows):
         buckets.setdefault((k, sig), []).append((idx, ri))
     return buckets
+
+
+def _bucket_rows_multi(job_plans) -> "_collections.OrderedDict":
+    """Cross-REQUEST extension of _bucket_rows: group the rows of
+    several (trace, rows) program plans into UNION kernel-signature
+    buckets, sig -> [(job index, row index, nest index, ref index)].
+
+    Keys by signature digest alone: across programs a nest index means
+    nothing, and the digest already captures everything a compiled
+    kernel bakes in as structure — every numeric difference between
+    member nests (trips, coeffs, geometry values) rides the per-row
+    (highs, vals) operands of the fused_multi kernel. Unlike a
+    single-program bucket, members need NOT share a draw plan: each is
+    planned with its own nest/config. Ordered by first appearance, so
+    per-member seeds (cfg.seed * 1000003 + row index within the
+    member's OWN program) and per-job result order stay exactly those
+    of each job's solo run."""
+    buckets: "_collections.OrderedDict" = _collections.OrderedDict()
+    for j, (trace, rows) in enumerate(job_plans):
+        for idx, (k, ri, ks, sig) in enumerate(rows):
+            buckets.setdefault(sig, []).append((j, idx, k, ri))
+    return buckets
+
+
+def _stack_vals(vals_list):
+    """Stack the vals overlays of signature-equal rows along a new
+    leading axis for the fused_multi kernel. Equal signatures
+    guarantee equal pytree structure and leaf shapes (_kernel_sig:
+    every concrete value the traced code reads outside vals is in the
+    signature), so the stack is always well-formed."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *vals_list
+    )
 
 
 # Max batch-sized chunks folded into ONE fused host-path dispatch
@@ -1549,3 +1653,287 @@ def run_sampled(
         with telemetry.span("merge", stage="fold_results"):
             state = fold_results(results, machine.thread_num, v2)
     return state, results
+
+
+def sampled_outputs_multi(
+    jobs, batch: int | None = None, capacity: int = DEFAULT_CAPACITY
+) -> list[list[SampledRefResult]]:
+    """Cross-REQUEST fused runner: several (program, machine, cfg) jobs
+    share one dispatch plan.
+
+    The engine half of the service's continuous batching
+    (service/executor.py::BatchScheduler): rows from every job are
+    planned into the UNION of kernel-signature buckets
+    (_bucket_rows_multi) and each bucket issues stacked vmapped
+    dispatches (the fused_multi kernel) whose rows mix members from all
+    jobs, padded to the dispatch's key-buffer shape with masked — hence
+    merge-inert — slots. Member exactness is preserved end to end:
+
+    - sample streams: each member draws with its OWN seed
+      (cfg.seed * 1000003 + its row index in its own program), its own
+      highs and target count — the same streams its solo run uses.
+      Device rows are bit-identical by the threefry counter-per-key
+      property (grouped only with equal planned buffer sizes B); host
+      draws happen per member on the numpy PCG stream.
+    - classification: the per-row scan body equals the solo fused
+      kernel's, with per-row (highs, vals) operands; cross-job numeric
+      differences ride vals, structure is pinned by the shared
+      signature.
+    - capacity regrows re-dispatch the whole batched group (counted
+      once per regrown dispatch, same as the fused path) and re-decode
+      deterministically, so a regrow under batching changes nothing at
+      member grain.
+    - decode/fold: pair counts are exact integers, dict accumulation is
+      order-insensitive, and cri_distribute iterates canonically — the
+      folded MRC bytes equal solo (tests/test_batching.py pins this
+      across mixed models, mixed N, and regrow).
+
+    A host member shorter than the group's unified chunk plan rides the
+    later dispatches fully masked (its padding rows merge nothing), so
+    chunk-layout differences vs its solo plan cannot change results.
+
+    Returns one result list per job, ordered like that job's solo
+    sampled_outputs. Telemetry mirrors the fused gauges computed over
+    the union plan — ref_buckets == ref_buckets_union, so the
+    tools/check_dispatch_stats.py bound applies unchanged — plus
+    batch_jobs and a dispatches_batched counter.
+    """
+    import time
+
+    if batch is None:
+        batch = default_batch()
+    plans = [
+        _program_kernels(program, machine)
+        for program, machine, _cfg in jobs
+    ]
+    depth = max(1, max((cfg.pipeline_depth for _p, _m, cfg in jobs),
+                       default=1))
+    results: dict[tuple[int, int], SampledRefResult] = {}
+    pending: list = []
+    cap = capacity
+    overlap_s = 0.0
+    n_buckets = 0
+    max_bucket_dispatches = 0
+    n_fused = 0
+    n_refs_fused = 0
+
+    def finalize(key, name, acc):
+        results[key] = SampledRefResult(
+            name=name, noshare=acc["noshare"], share=acc["share"],
+            cold=acc["cold"], n_samples=acc["n_samples"],
+        )
+
+    def drain(entry):
+        nonlocal cap, overlap_s
+        overlap_s += max(0.0, time.perf_counter() - entry["t0"])
+        dispatch_cap = entry["cap"]
+        with telemetry.span("fetch", fused=True, batched=True):
+            mk, mc, max_nu, cold = telemetry.record_fetch(
+                jax.device_get(entry["out"])
+            )
+        while int(max_nu.max()) > dispatch_cap:
+            dispatch_cap = max(dispatch_cap * 4, int(max_nu.max()))
+            cap = max(cap, dispatch_cap)
+            telemetry.count("capacity_regrows")
+            with telemetry.span("fetch", fused=True, regrow=True):
+                mk, mc, max_nu, cold = telemetry.record_fetch(
+                    jax.device_get(entry["redo"](dispatch_cap))
+                )
+        with telemetry.span("merge"):
+            for row, (key, name, acc) in enumerate(entry["members"]):
+                acc["cold"] += float(cold[row])
+                decode_pairs(mk[row], mc[row], acc["noshare"],
+                             acc["share"])
+                acc["left"] -= 1
+                if acc["left"] == 0:
+                    finalize(key, name, acc)
+
+    def dispatch_group(fused, mem, make_inputs, ph_R, nv_R, rx_R,
+                       n_chunks):
+        nonlocal n_fused, n_refs_fused
+
+        def redo(c2):
+            keys_RB, mask_RB = make_inputs()
+            telemetry.count("dispatches")
+            telemetry.count("dispatches_fused")
+            telemetry.count("dispatches_batched")
+            return fused(keys_RB, mask_RB, ph_R, nv_R, rx_R, c2,
+                         n_chunks)
+
+        with telemetry.span("dispatch", form="fused_multi",
+                            refs=len(mem)):
+            out = redo(cap)
+        for arr in out:
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        n_fused += 1
+        n_refs_fused += len(mem)
+        pending.append({
+            "out": out, "redo": redo, "cap": cap, "members": mem,
+            "t0": time.perf_counter(),
+        })
+        while len(pending) >= depth:
+            telemetry.count("pipeline_stalls")
+            drain(pending.pop(0))
+
+    for sig, members_all in _bucket_rows_multi(plans).items():
+        members = []
+        for j, idx, k, ri in members_all:
+            trace, rows = plans[j]
+            nt = trace.nests[k]
+            cfg = jobs[j][2]
+            highs, s = _sample_highs(nt, ri, cfg)
+            members.append({
+                "key": (j, idx), "nt": nt, "ri": ri, "cfg": cfg,
+                "name": nt.tables.ref_names[ri], "highs": highs,
+                "s": s, "seed": cfg.seed * 1000003 + idx,
+                "ks": rows[idx][2], "drawn": None,
+                "acc": {"noshare": {}, "share": {}, "cold": 0.0,
+                        "n_samples": 0, "left": 0},
+            })
+        live = []
+        for m in members:
+            if m["s"] == 0:  # degenerate ref: nothing to draw
+                finalize(m["key"], m["name"], m["acc"])
+            else:
+                live.append(m)
+        if not live:
+            continue
+        n_buckets += 1
+        bspan = telemetry.span(
+            "bucket", engine="sampled", batched=True,
+            refs=",".join(m["name"] for m in live),
+        )
+        bspan.__enter__()
+        dev_entries = [m for m in live if _use_device_draw(m["cfg"])]
+        if dev_entries:
+            from .draw import draw_bucket_keys_device_multi
+
+            with telemetry.span("draw", where="device"):
+                out = draw_bucket_keys_device_multi(
+                    [(m["nt"], m["ri"], m["cfg"], m["seed"])
+                     for m in dev_entries],
+                    batch,
+                )
+            for m, d in zip(dev_entries, out):
+                m["drawn"] = d
+        host_members = [m for m in live if m["drawn"] is None]
+        dev_groups: dict[int, list] = {}
+        for m in live:
+            if m["drawn"] is None:
+                continue
+            sk, chosen, s_m, _hi = m["drawn"]
+            m["acc"]["n_samples"] = s_m
+            # only equal planned buffer sizes stack — the threefry
+            # stream of a row depends on its B, so a member keeps the
+            # exact buffer its solo run would have drawn
+            dev_groups.setdefault(int(sk.shape[0]), []).append(
+                (m, sk, chosen)
+            )
+        fused = live[0]["ks"]["fused_multi"]
+        bucket_dispatches = 0
+        for B, grp in dev_groups.items():
+            rx_R = jnp.asarray([m["ri"] for m, _, _ in grp], jnp.int64)
+            ph_R = jnp.asarray(
+                np.stack([_pad_highs(m["highs"]) for m, _, _ in grp])
+            )
+            nv_R = _stack_vals([m["nt"].vals for m, _, _ in grp])
+            mem = []
+            for m, _, _ in grp:
+                m["acc"]["left"] += 1
+                mem.append((m["key"], m["name"], m["acc"]))
+
+            def make_inputs(grp=grp):
+                return (
+                    jnp.stack([sk for _, sk, _ in grp]),
+                    jnp.stack([ch for _, _, ch in grp]),
+                )
+
+            dispatch_group(fused, mem, make_inputs, ph_R, nv_R, rx_R,
+                           B // batch)
+            bucket_dispatches += 1
+        if host_members:
+            with telemetry.span("draw", where="host"):
+                for m in host_members:
+                    keys_all, _hi = draw_sample_keys(
+                        m["nt"], m["ri"], m["cfg"], seed=m["seed"]
+                    )
+                    m["acc"]["n_samples"] = len(keys_all)
+                    m["keys"] = keys_all
+            g, n_groups = _host_fuse_plan(
+                max(len(m["keys"]) for m in host_members), batch
+            )
+            span_len = g * batch
+            rx_R = jnp.asarray([m["ri"] for m in host_members],
+                               jnp.int64)
+            ph_R = jnp.asarray(
+                np.stack([_pad_highs(m["highs"])
+                          for m in host_members])
+            )
+            nv_R = _stack_vals([m["nt"].vals for m in host_members])
+            mem = []
+            for m in host_members:
+                m["acc"]["left"] += n_groups
+                mem.append((m["key"], m["name"], m["acc"]))
+            for gi in range(n_groups):
+                lo = gi * span_len
+
+                def make_inputs(lo=lo, hm=host_members,
+                                span_len=span_len):
+                    buf = np.empty((len(hm), span_len), dtype=np.int64)
+                    msk = np.zeros((len(hm), span_len), dtype=bool)
+                    for row, m in enumerate(hm):
+                        seg = m["keys"][lo:lo + span_len]
+                        buf[row, :len(seg)] = seg
+                        buf[row, len(seg):] = m["keys"][0]
+                        msk[row, :len(seg)] = True
+                    return jnp.asarray(buf), jnp.asarray(msk)
+
+                dispatch_group(fused, mem, make_inputs, ph_R, nv_R,
+                               rx_R, g)
+                bucket_dispatches += 1
+        bspan.__exit__(None, None, None)
+        max_bucket_dispatches = max(max_bucket_dispatches,
+                                    bucket_dispatches)
+    while pending:
+        drain(pending.pop(0))
+    telemetry.gauge("fuse_refs", 1)
+    telemetry.gauge("pipeline_depth", depth)
+    telemetry.gauge("ref_buckets", n_buckets)
+    telemetry.gauge("ref_buckets_union", n_buckets)
+    telemetry.gauge("expected_chunks", max_bucket_dispatches)
+    telemetry.gauge("pipeline_overlap_s", overlap_s)
+    telemetry.gauge("batch_jobs", len(jobs))
+    if n_fused:
+        telemetry.gauge("refs_per_dispatch", n_refs_fused / n_fused)
+    return [
+        [results[(j, idx)] for idx in range(len(rows))]
+        for j, (_trace, rows) in enumerate(plans)
+    ]
+
+
+def run_sampled_multi(
+    jobs, batch: int | None = None, capacity: int = DEFAULT_CAPACITY
+) -> list[tuple[PRIState, list[SampledRefResult]]]:
+    """Batched engine entry point: jobs is
+    [(program, machine, cfg | None, v2)], the return is one
+    (PRIState, results) per job — each bit-identical to
+    run_sampled(program, machine, cfg, v2=v2) on its own (the service
+    batcher's contract; see sampled_outputs_multi)."""
+    norm = [
+        (p, m, c if c is not None else SamplerConfig(), bool(v2))
+        for p, m, c, v2 in jobs
+    ]
+    with telemetry.span("engine", engine="sampled",
+                        batch_members=len(norm)):
+        outs = sampled_outputs_multi(
+            [(p, m, c) for p, m, c, _v2 in norm],
+            batch=batch, capacity=capacity,
+        )
+        folded = []
+        with telemetry.span("merge", stage="fold_results"):
+            for (_p, m, _c, v2), res in zip(norm, outs):
+                folded.append((fold_results(res, m.thread_num, v2), res))
+    return folded

@@ -234,12 +234,21 @@ class AnalysisService:
     def __init__(self, cache_dir: str | None = None,
                  max_workers: int = 4, mem_entries: int = 128,
                  runner=default_runner,
-                 ledger_path: str | None = None):
+                 ledger_path: str | None = None,
+                 batch_window_ms: float | None = None,
+                 batch_max_refs: int = 64):
+        from ..config import BatchConfig
+
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
         self.ledger_path = ledger_path
         self.executor = RequestExecutor(
             self.cache, max_workers=max_workers, runner=runner,
             ledger_path=ledger_path,
+            batching=(
+                BatchConfig(window_ms=batch_window_ms,
+                            max_refs=batch_max_refs)
+                if batch_window_ms is not None else None
+            ),
         )
 
     def healthz(self) -> dict:
@@ -255,16 +264,19 @@ class AnalysisService:
             "store_version": STORE_VERSION,
             "in_flight": ex["in_flight"],
             "queue_depth": ex["queue_depth"],
+            "batch_queue_depth": ex["batch_queue_depth"],
             "ledger": self.ledger_path,
         }
 
     def stats(self, ledger_tail: int = 5) -> dict:
         """Full introspection snapshot (the `stats` request type):
-        executor queue/coalesce/degradation counters, cache tier
-        stats, and the ledger tail."""
+        executor queue/coalesce/degradation counters incl. batch
+        occupancy and batched-vs-solo latency, cache tier stats, the
+        ledger tail, and — when a ledger is configured — the ledger's
+        cross-run batching aggregate (joined on batch_id rows)."""
         from ..runtime.obs import ledger as obs_ledger
 
-        return {
+        out = {
             "executor": self.executor.stats(),
             "cache": self.cache.stats(),
             "ledger": self.ledger_path,
@@ -273,6 +285,15 @@ class AnalysisService:
                 if self.ledger_path else []
             ),
         }
+        if self.ledger_path:
+            try:
+                agg = obs_ledger.aggregate(
+                    obs_ledger.read_rows(self.ledger_path)
+                )
+                out["batching"] = agg.get("batching")
+            except Exception:
+                out["batching"] = None
+        return out
 
     def submit(self, request: AnalysisRequest) -> AnalysisTicket:
         """Validate, fingerprint, and schedule (or join) a request.

@@ -39,11 +39,13 @@ engine internals.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
 import time
+import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 
-from ..config import MachineConfig, SamplerConfig
+from ..config import BatchConfig, MachineConfig, SamplerConfig
 from ..ir import Program
 from ..runtime import report, telemetry
 from ..runtime.aet import aet_mrc
@@ -109,28 +111,57 @@ def default_runner(engine: str, program: Program,
 
         return run_exact(program, machine), None
     if engine == "sampled":
-        import types
-
         from ..sampler.sampled import run_sampled
 
-        kw = {}
-        if request.device_draw is not None:
-            kw["device_draw"] = request.device_draw
-        if request.fuse_refs is not None:
-            kw["fuse_refs"] = request.fuse_refs
-        if request.pipeline_depth is not None:
-            kw["pipeline_depth"] = request.pipeline_depth
-        cfg = SamplerConfig(
-            ratio=request.ratio, seed=request.seed, **kw
+        state, results = run_sampled(
+            program, machine, sampler_config(request), v2=v2
         )
-        state, results = run_sampled(program, machine, cfg, v2=v2)
-        res = types.SimpleNamespace(
-            state=state,
-            total_accesses=sum(r.n_samples for r in results),
-            engine="sampled",
-        )
-        return res, results
+        return _sampled_namespace(state, results), results
     raise ValueError(f"unknown service engine {engine!r}")
+
+
+def sampler_config(request) -> SamplerConfig:
+    """The SamplerConfig one request's sampled execution uses — shared
+    by the solo runner and the batch runner so a member's config (and
+    hence its sample streams) cannot depend on which path served it."""
+    kw = {}
+    if request.device_draw is not None:
+        kw["device_draw"] = request.device_draw
+    if request.fuse_refs is not None:
+        kw["fuse_refs"] = request.fuse_refs
+    if request.pipeline_depth is not None:
+        kw["pipeline_depth"] = request.pipeline_depth
+    return SamplerConfig(ratio=request.ratio, seed=request.seed, **kw)
+
+
+def _sampled_namespace(state, results):
+    import types
+
+    return types.SimpleNamespace(
+        state=state,
+        total_accesses=sum(r.n_samples for r in results),
+        engine="sampled",
+    )
+
+
+def default_batch_runner(jobs):
+    """Run several sampled requests as ONE batched engine execution.
+
+    `jobs` is [(request, program, machine)]; the return is one
+    (result-namespace, per_ref) pair per job, each bit-identical to
+    default_runner("sampled", ...) on that job alone
+    (sampler/sampled.py::run_sampled_multi)."""
+    from ..sampler.sampled import run_sampled_multi
+
+    outs = run_sampled_multi([
+        (program, machine, sampler_config(request),
+         request.runtime == "v2")
+        for request, program, machine in jobs
+    ])
+    return [
+        (_sampled_namespace(state, results), results)
+        for state, results in outs
+    ]
 
 
 def execute_request(request, program: Program, machine: MachineConfig,
@@ -144,11 +175,24 @@ def execute_request(request, program: Program, machine: MachineConfig,
     with telemetry.span("service_exec", engine=engine,
                         program=program.name):
         res, per_ref = runner(engine, program, machine, request)
-        rih = cri_distribute(
-            res.state, machine.thread_num, machine.thread_num
+        record = build_record(
+            request, machine, engine, fingerprint, res, per_ref
         )
-        mrc = aet_mrc(rih, machine)
     telemetry.count("service_exec_done")
+    return record
+
+
+def build_record(request, machine: MachineConfig, engine: str,
+                 fingerprint: str, res, per_ref) -> dict:
+    """Fold one engine result (state + per-ref outputs) through the
+    reference pipeline into the versioned record service/cache.py
+    stores. Shared by the solo path and the batch path, so a batch
+    member's record is byte-for-byte the one its solo run would
+    cache."""
+    rih = cri_distribute(
+        res.state, machine.thread_num, machine.thread_num
+    )
+    mrc = aet_mrc(rih, machine)
     label = "samples" if per_ref is not None else "accesses"
     dump_lines = []
     dump_lines += report.noshare_dump(res.state)
@@ -179,15 +223,157 @@ def execute_request(request, program: Program, machine: MachineConfig,
     return record
 
 
+@dataclasses.dataclass
+class _BatchEntry:
+    """One request queued in the batch admission window."""
+
+    request: object
+    program: Program
+    machine: MachineConfig
+    fingerprint: str
+    future: Future
+    refs: int  # tracked refs this member contributes to max_refs
+    enqueued_at: float  # perf_counter at submit
+    deadline: float | None  # absolute perf_counter bound, or None
+
+
+class BatchScheduler:
+    """Bounded admission window between submit and engine execution.
+
+    Compatible concurrent requests (today: every sampled request — the
+    engine batches at kernel-signature grain, so ANY mix of models/N
+    is mergeable) queue here instead of going straight to the pool.
+    A batch flushes when the OLDEST member has waited window_ms, or
+    earlier when the summed tracked-ref count reaches max_refs; the
+    overflow remainder seeds the next batch (overflow splitting).
+    A member whose deadline expires while queued is evicted and failed
+    immediately with deadline_abandoned counted — it never rides the
+    batch just to have its result discarded.
+
+    Purely a scheduler: WHAT each member computes is pinned bit-equal
+    to its solo run by the engine layer (run_sampled_multi), so the
+    only observable trade-off is latency (up to window_ms of added
+    wait) against dispatch amortization (batch_occupancy refs per
+    fused dispatch).
+    """
+
+    def __init__(self, executor: "RequestExecutor",
+                 window_ms: float, max_refs: int):
+        self._executor = executor
+        self._window_s = max(0.0, window_ms) / 1000.0
+        self._max_refs = max(1, max_refs)
+        self._queue: list[_BatchEntry] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="pluss-batch-window",
+        )
+        self._thread.start()
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def enqueue(self, entry: _BatchEntry) -> None:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batch scheduler is closed")
+            self._queue.append(entry)
+            telemetry.gauge("batch_queue_depth", len(self._queue))
+            self._cv.notify()
+
+    def close(self) -> None:
+        """Stop admitting; the loop flushes whatever is queued before
+        exiting, so no enqueued future is ever left unresolved."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+
+    # -- window loop --------------------------------------------------
+
+    def _pop_batch_locked(self) -> list[_BatchEntry]:
+        """Greedy prefix up to max_refs. The first entry is always
+        taken (an oversize single request still runs — max_refs bounds
+        merging, not admissible work); the remainder re-queues and,
+        its window having effectively elapsed, flushes on the next
+        loop iteration."""
+        batch: list[_BatchEntry] = []
+        total = 0
+        while self._queue:
+            e = self._queue[0]
+            if batch and total + e.refs > self._max_refs:
+                break
+            batch.append(self._queue.pop(0))
+            total += e.refs
+        telemetry.gauge("batch_queue_depth", len(self._queue))
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            expired: list[_BatchEntry] = []
+            batch: list[_BatchEntry] = []
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                flush_at = self._queue[0].enqueued_at + self._window_s
+                while not self._closed:
+                    now = time.perf_counter()
+                    live = []
+                    for e in self._queue:
+                        if e.deadline is not None and e.deadline <= now:
+                            expired.append(e)
+                        else:
+                            live.append(e)
+                    if expired:
+                        # fail the expiries NOW (their futures resolve
+                        # outside the lock below) instead of holding
+                        # them until the window flushes; the survivors
+                        # keep waiting on the next outer iteration
+                        self._queue = live
+                        telemetry.gauge(
+                            "batch_queue_depth", len(self._queue)
+                        )
+                        break
+                    if now >= flush_at or (
+                        sum(e.refs for e in self._queue)
+                        >= self._max_refs
+                    ):
+                        batch = self._pop_batch_locked()
+                        break
+                    wake = flush_at
+                    for e in self._queue:
+                        if e.deadline is not None:
+                            wake = min(wake, e.deadline)
+                    self._cv.wait(timeout=max(0.0, wake - now))
+                else:
+                    # closed: drain whatever is still queued (one
+                    # max_refs-bounded batch per outer iteration)
+                    batch = self._pop_batch_locked()
+            # executor work runs OUTSIDE the condition lock: expiry
+            # resolves futures (whose callbacks take executor locks)
+            # and _submit_batch touches the pool
+            for e in expired:
+                self._executor._expire_queued(e)
+            if batch:
+                self._executor._submit_batch(batch)
+
+
 class RequestExecutor:
     """Singleflight + bounded concurrency + deadlines over
     `execute_request`. One instance backs one AnalysisService."""
 
     def __init__(self, cache: ResultCache | None = None,
                  max_workers: int = 4, runner=default_runner,
-                 ledger_path: str | None = None):
+                 ledger_path: str | None = None,
+                 batching: BatchConfig | None = None,
+                 batch_runner=default_batch_runner):
         self.cache = cache if cache is not None else ResultCache()
         self.runner = runner
+        self.batch_runner = batch_runner
         self.max_workers = max_workers
         self.ledger_path = ledger_path
         self._pool = ThreadPoolExecutor(
@@ -201,6 +387,17 @@ class RequestExecutor:
         # a run is enabled, but a long-lived service must answer
         # introspection requests at any time
         self._stats = collections.Counter()
+        # batching observability for stats(): per-batch member counts
+        # and cold (cache-miss) latencies batched vs solo, bounded so a
+        # long-lived service cannot grow them without limit
+        self._batch_occupancy: list[int] = []
+        self._lat_batched: list[float] = []
+        self._lat_solo: list[float] = []
+        self._obs_cap = 512
+        self._batcher = (
+            BatchScheduler(self, batching.window_ms, batching.max_refs)
+            if batching is not None else None
+        )
         if ledger_path:
             # compile-counter deltas in ledger rows need the
             # process-global jax.monitoring listeners; without jax the
@@ -217,16 +414,49 @@ class RequestExecutor:
         with self._lock:
             out = dict(self._stats)
             inflight = len(self._inflight)
+            occupancy = sorted(self._batch_occupancy)
+            lat_b = sorted(self._lat_batched)
+            lat_s = sorted(self._lat_solo)
         for key in ("submitted", "coalesced", "completed", "failed",
                     "degraded", "deadline_abandoned", "active",
-                    "ledger_rows", "ledger_write_failed"):
+                    "ledger_rows", "ledger_write_failed",
+                    "batches_formed", "batch_members",
+                    "batch_fallback_solo"):
             out.setdefault(key, 0)
         active = out.pop("active")
         out["in_flight"] = inflight
         out["executing"] = active
         out["queue_depth"] = max(0, inflight - active)
         out["max_workers"] = self.max_workers
+        out["batch_queue_depth"] = (
+            self._batcher.queue_depth() if self._batcher else 0
+        )
+        if occupancy:
+            out["batch_occupancy_p50"] = obs_ledger._percentile(
+                occupancy, 0.50
+            )
+            out["batch_occupancy_p95"] = obs_ledger._percentile(
+                occupancy, 0.95
+            )
+        if lat_b:
+            out["batched_p50_latency_s"] = round(
+                obs_ledger._percentile(lat_b, 0.50), 6
+            )
+        if lat_s:
+            out["solo_p50_latency_s"] = round(
+                obs_ledger._percentile(lat_s, 0.50), 6
+            )
         return out
+
+    def _note_latency(self, outcome: dict, batched: bool) -> None:
+        """Collect cold-execution latencies for the batched-vs-solo
+        stats comparison (warm cache hits would swamp both sides)."""
+        if outcome["record"] is None or outcome["cache"] != "miss":
+            return
+        dest = self._lat_batched if batched else self._lat_solo
+        with self._lock:
+            if len(dest) < self._obs_cap:
+                dest.append(outcome["latency_s"])
 
     def _count(self, key: str, inc: int = 1) -> None:
         with self._lock:
@@ -242,6 +472,10 @@ class RequestExecutor:
         + serving metadata). Identical fingerprints submitted while
         one is in flight share its future."""
         telemetry.count("service_requests")
+        batchable = (
+            self._batcher is not None and self._batchable(request)
+        )
+        entry = None
         with self._lock:
             self._stats["submitted"] += 1
             fut = self._inflight.get(fingerprint)
@@ -249,9 +483,27 @@ class RequestExecutor:
                 self._stats["coalesced"] += 1
                 telemetry.count("service_coalesced")
                 return fut
-            fut = self._pool.submit(
-                self._process, request, program, machine, fingerprint
-            )
+            if batchable:
+                # the admission window resolves this future itself;
+                # singleflight still coalesces identical fingerprints
+                # onto it while it waits or runs
+                fut = Future()
+                fut.set_running_or_notify_cancel()
+                entry = _BatchEntry(
+                    request=request, program=program, machine=machine,
+                    fingerprint=fingerprint, future=fut,
+                    refs=sum(len(n.refs) for n in program.nests),
+                    enqueued_at=time.perf_counter(),
+                    deadline=(
+                        None if request.deadline_s is None
+                        else time.perf_counter() + request.deadline_s
+                    ),
+                )
+            else:
+                fut = self._pool.submit(
+                    self._process, request, program, machine,
+                    fingerprint,
+                )
             self._inflight[fingerprint] = fut
             telemetry.gauge("service_queue_depth", len(self._inflight))
 
@@ -266,9 +518,23 @@ class RequestExecutor:
         # runs the callback synchronously on this thread, and the
         # callback itself takes the lock
         fut.add_done_callback(_done)
+        if entry is not None:
+            self._batcher.enqueue(entry)
         return fut
 
+    @staticmethod
+    def _batchable(request) -> bool:
+        """The compatibility predicate: which requests may share a
+        batched execution. Today exactly the sampled engine — the only
+        one with a multi-job runner; kernel-signature bucketing makes
+        any mix of models/N/configs mergeable within it."""
+        return request.engine == "sampled"
+
     def shutdown(self) -> None:
+        if self._batcher is not None:
+            # flush the admission window through the pool BEFORE the
+            # pool stops accepting work
+            self._batcher.close()
         self._pool.shutdown(wait=True)
 
     # -- worker -------------------------------------------------------
@@ -308,14 +574,196 @@ class RequestExecutor:
                 if record is not None else None
             ),
         }
+        self._note_latency(outcome, batched=False)
         if self.ledger_path:
             self._append_ledger_row(
                 request, fingerprint, outcome, compiles0
             )
         return outcome
 
+    # -- batched worker -----------------------------------------------
+
+    def _submit_batch(self, entries: list[_BatchEntry]) -> None:
+        """Hand one flushed admission window to the pool (called by
+        the BatchScheduler loop, never under its condition lock)."""
+        self._pool.submit(self._process_batch, entries)
+
+    def _process_batch(self, entries: list[_BatchEntry]) -> None:
+        """Run one flushed window as (at most) one batched engine
+        execution, resolving every member's future.
+
+        Members are peeled off first when the batch cannot or need not
+        carry them: warm cache hits are served immediately (zero
+        executions — the singleflight/caching invariant), queued
+        deadline expiries fail immediately, and members whose program
+        fails to lower (pre-flight kernel build) fall back to the solo
+        chain. Everything left runs through ONE batch_runner call; a
+        batch-level failure degrades every member to solo execution
+        rather than failing them collectively."""
+        compiles0 = (
+            telemetry.compile_counters_snapshot()
+            if self.ledger_path else None
+        )
+        runnable: list[_BatchEntry] = []
+        for e in entries:
+            if e.deadline is not None and e.deadline <= time.perf_counter():
+                self._expire_queued(e)
+                continue
+            record, tier = self.cache.get(e.fingerprint)
+            if record is not None:
+                self._count("completed")
+                outcome = {
+                    "record": record,
+                    "cache": tier,
+                    "degraded": [],
+                    "error": None,
+                    "latency_s": round(
+                        time.perf_counter() - e.enqueued_at, 6
+                    ),
+                    "mrc_digest": obs_ledger.mrc_digest(record["mrc"]),
+                }
+                self._finish(e, outcome, compiles0)
+                continue
+            try:
+                # pre-flight: an unlowerable program must not poison
+                # the shared dispatch — send it down the solo chain
+                # (whose own error handling owns the failure)
+                from ..sampler.sampled import _program_kernels
+
+                _program_kernels(e.program, e.machine)
+            except Exception:
+                self._solo_fallback(e, compiles0)
+                continue
+            runnable.append(e)
+        if not runnable:
+            return
+        batch_id = uuid.uuid4().hex[:8]
+        self._count("batches_formed")
+        self._count("batch_members", len(runnable))
+        with self._lock:
+            if len(self._batch_occupancy) < self._obs_cap:
+                self._batch_occupancy.append(len(runnable))
+        telemetry.count("batches_formed")
+        telemetry.count("batch_members", len(runnable))
+        telemetry.gauge("batch_occupancy", len(runnable))
+        self._count("active")
+        telemetry.count("service_exec_started")
+        try:
+            with telemetry.span("service_exec", engine="sampled",
+                                batch=len(runnable), batch_id=batch_id):
+                outs = self.batch_runner([
+                    (e.request, e.program, e.machine) for e in runnable
+                ])
+            telemetry.count("service_exec_done")
+        except Exception:
+            # one shared dispatch failed: no member is served a
+            # collective error — each re-runs solo
+            telemetry.count("service_batch_failed")
+            for e in runnable:
+                self._solo_fallback(e, compiles0)
+            return
+        finally:
+            self._count("active", -1)
+        for e, (res, per_ref) in zip(runnable, outs):
+            try:
+                record = build_record(
+                    e.request, e.machine, "sampled", e.fingerprint,
+                    res, per_ref,
+                )
+                # per-member cache write: EVERY member lands in the
+                # store under its own fingerprint, so a warm repeat of
+                # any of them is a hit with zero executions
+                self.cache.put(e.fingerprint, record)
+            except Exception:
+                self._solo_fallback(e, compiles0)
+                continue
+            self._count("completed")
+            outcome = {
+                "record": record,
+                "cache": "miss",
+                "degraded": [],
+                "error": None,
+                # from enqueue: the member's latency honestly includes
+                # its admission-window wait — the trade-off the
+                # batched-vs-solo stats exist to show
+                "latency_s": round(
+                    time.perf_counter() - e.enqueued_at, 6
+                ),
+                "mrc_digest": obs_ledger.mrc_digest(record["mrc"]),
+            }
+            self._note_latency(outcome, batched=True)
+            self._finish(e, outcome, compiles0, batch_id=batch_id,
+                         batch_members=len(runnable))
+
+    def _solo_fallback(self, e: _BatchEntry, compiles0) -> None:
+        """Degrade one batch member to the solo execution chain."""
+        self._count("batch_fallback_solo")
+        telemetry.count("service_batch_fallback_solo")
+        try:
+            record, degraded, error = self._run_chain(
+                e.request, e.program, e.machine, e.fingerprint
+            )
+            if record is not None and not degraded:
+                self.cache.put(e.fingerprint, record)
+        except Exception as exc:
+            record, degraded, error = None, [], repr(exc)
+        self._count("completed" if record is not None else "failed")
+        outcome = {
+            "record": record,
+            "cache": "miss",
+            "degraded": degraded,
+            "error": error,
+            "latency_s": round(time.perf_counter() - e.enqueued_at, 6),
+            "mrc_digest": (
+                obs_ledger.mrc_digest(record["mrc"])
+                if record is not None else None
+            ),
+        }
+        self._note_latency(outcome, batched=False)
+        self._finish(e, outcome, compiles0)
+
+    def _expire_queued(self, e: _BatchEntry) -> None:
+        """Fail a member whose deadline passed while it sat in the
+        admission window — immediately, instead of riding the batch
+        and discarding the result afterward (the deadline fix)."""
+        self._count("deadline_abandoned")
+        self._count("failed")
+        telemetry.count("service_deadline_abandoned")
+        outcome = {
+            "record": None,
+            "cache": None,
+            "degraded": [],
+            "error": (
+                f"deadline {e.request.deadline_s}s expired in the "
+                "batch admission window (deadline_abandoned)"
+            ),
+            "latency_s": round(time.perf_counter() - e.enqueued_at, 6),
+            "mrc_digest": None,
+        }
+        compiles0 = (
+            telemetry.compile_counters_snapshot()
+            if self.ledger_path else None
+        )
+        self._finish(e, outcome, compiles0)
+
+    def _finish(self, e: _BatchEntry, outcome: dict, compiles0,
+                batch_id: str | None = None,
+                batch_members: int | None = None) -> None:
+        """Ledger + future resolution for one batch member."""
+        if self.ledger_path:
+            extra = {}
+            if batch_id is not None:
+                extra = {"batch_id": batch_id,
+                         "batch_members": batch_members}
+            self._append_ledger_row(
+                e.request, e.fingerprint, outcome, compiles0,
+                extra=extra,
+            )
+        e.future.set_result(outcome)
+
     def _append_ledger_row(self, request, fingerprint: str,
-                           outcome: dict, compiles0: dict) -> None:
+                           outcome: dict, compiles0: dict,
+                           extra: dict | None = None) -> None:
         """One ledger row per execution (cache hits included, since a
         served response is an execution of the SERVICE even when the
         engine never ran; coalesced callers share the executing row).
@@ -350,6 +798,8 @@ class RequestExecutor:
         }
         if outcome["error"] is not None:
             row["error"] = str(outcome["error"])[:300]
+        if extra:
+            row.update(extra)
         try:
             obs_ledger.append(self.ledger_path, row)
             self._count("ledger_rows")

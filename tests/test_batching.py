@@ -1,0 +1,361 @@
+"""Cross-request continuous batching: the engine-level multi-job
+runner (run_sampled_multi) and the service's admission window
+(service/executor.py::BatchScheduler).
+
+The ISSUE-7 acceptance invariants are pinned here: every batch
+member's results and MRC are BIT-IDENTICAL to its solo run across
+mixed models, mixed N, and capacity regrows; N distinct concurrent
+submissions merge into at most ceil(refs / batch_max_refs) engine
+executions; a queued member whose deadline expires fails immediately
+instead of riding the window; and a batch-level failure degrades
+members to the solo chain rather than failing them collectively.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.models import REGISTRY
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
+from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
+from pluss_sampler_optimization_tpu.runtime.obs import (
+    ledger as obs_ledger,
+)
+from pluss_sampler_optimization_tpu.sampler.sampled import (
+    run_sampled,
+    run_sampled_multi,
+)
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisRequest,
+    AnalysisService,
+    serve_jsonl,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+import check_ledger  # noqa: E402
+
+MACHINE = MachineConfig()
+
+# mixed models AND mixed N, each with its own sampling stream: the
+# two gemm jobs share kernel-signature buckets (numeric bounds ride
+# the vals operands), 2mm contributes its own
+JOBS = [
+    ("gemm", 24, SamplerConfig(ratio=0.3, seed=5)),
+    ("gemm", 32, SamplerConfig(ratio=0.2, seed=7)),
+    ("2mm", 12, SamplerConfig(ratio=0.25, seed=11)),
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _mrc(state, machine=MACHINE):
+    T = machine.thread_num
+    return aet_mrc(cri_distribute(state, T, T), machine)
+
+
+def _sampled_req(**kw):
+    base = dict(model="gemm", n=16, engine="sampled", ratio=0.3,
+                seed=1)
+    base.update(kw)
+    return AnalysisRequest(**base)
+
+
+def _solo_mrc(req):
+    """The canonical solo-engine MRC for a service request."""
+    machine = req.machine()
+    state, _results = run_sampled(
+        req.build_program(), machine,
+        SamplerConfig(ratio=req.ratio, seed=req.seed),
+    )
+    return _mrc(state, machine)
+
+
+# -- engine layer -----------------------------------------------------
+
+
+def test_multi_job_bit_identical_to_solo_mixed_models():
+    """The tentpole contract at engine grain: one run_sampled_multi
+    over mixed models and mixed N returns, per job, the same per-ref
+    results and MRC bytes as that job's own run_sampled — while
+    actually merging the jobs into a UNION bucket plan (fewer buckets
+    than the solo runs dispatch in total)."""
+    jobs = [(REGISTRY[m](n), MACHINE, cfg, False)
+            for m, n, cfg in JOBS]
+    tele = telemetry.enable()
+    outs = run_sampled_multi(jobs)
+    telemetry.disable()
+    assert len(outs) == len(JOBS)
+    assert tele.gauges["batch_jobs"] == len(JOBS)
+    assert tele.gauges["ref_buckets_union"] == tele.gauges["ref_buckets"]
+    assert tele.counters.get("dispatches_batched", 0) >= 1
+    bound = (
+        tele.gauges["ref_buckets_union"]
+        * tele.gauges["expected_chunks"]
+        + tele.counters.get("capacity_regrows", 0)
+    )
+    assert tele.counters["dispatches"] <= bound
+
+    solo_buckets = 0
+    for (m, n, cfg), (state, results) in zip(JOBS, outs):
+        prog = REGISTRY[m](n)
+        s_state, s_results = run_sampled(prog, MACHINE, cfg)
+        assert results == s_results
+        assert _mrc(state).tobytes() == _mrc(s_state).tobytes()
+        t_solo = telemetry.enable()
+        run_sampled(prog, MACHINE,
+                    dataclasses.replace(cfg, fuse_refs=True))
+        telemetry.disable()
+        solo_buckets += t_solo.gauges["ref_buckets"]
+    # the merge is real: the union plan dispatches fewer buckets than
+    # the three solo fused plans combined (the two gemm jobs share)
+    assert tele.gauges["ref_buckets_union"] < solo_buckets
+
+
+def test_multi_job_regrow_bit_identical():
+    """A capacity regrow under batching re-dispatches the whole merged
+    group — and still decodes every member bit-equal to its solo run
+    at the same starting capacity."""
+    spec = [
+        ("gemm", 16, SamplerConfig(ratio=0.3, seed=2)),
+        ("gemm", 24, SamplerConfig(ratio=0.25, seed=3)),
+    ]
+    tele = telemetry.enable()
+    outs = run_sampled_multi(
+        [(REGISTRY[m](n), MACHINE, c, False) for m, n, c in spec],
+        capacity=1,
+    )
+    telemetry.disable()
+    assert tele.counters.get("capacity_regrows", 0) >= 1
+    for (m, n, c), (_state, results) in zip(spec, outs):
+        _s, solo = run_sampled(REGISTRY[m](n), MACHINE, c, capacity=1)
+        assert results == solo
+
+
+# -- service layer ----------------------------------------------------
+
+
+def test_service_batches_concurrent_distinct_requests(tmp_path):
+    """Three DISTINCT concurrent sampled requests inside one admission
+    window: ONE engine execution, per-request MRC bytes equal the solo
+    runs, every member lands in the cache under its own fingerprint
+    (a fresh service serves all three warm with zero executions), and
+    the ledger rows share one batch_id the aggregate rolls up."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    reqs = [
+        _sampled_req(model=m, n=n, ratio=cfg.ratio, seed=cfg.seed)
+        for m, n, cfg in JOBS
+    ]
+    tele = telemetry.enable()
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"), ledger_path=ledger_path,
+        batch_window_ms=400.0,
+    ) as svc:
+        tickets = [svc.submit(r) for r in reqs]
+        resps = [svc.result(t, timeout=300) for t in tickets]
+        stats = svc.executor.stats()
+    telemetry.disable()
+    assert all(r.ok for r in resps)
+    assert all(r.cache == "miss" for r in resps)
+    assert tele.counters.get("service_exec_started") == 1
+    assert tele.counters.get("batches_formed") == 1
+    assert tele.counters.get("batch_members") == len(reqs)
+    assert stats["batches_formed"] == 1
+    assert stats["batch_members"] == len(reqs)
+    assert stats["batch_occupancy_p50"] == len(reqs)
+    assert "batched_p50_latency_s" in stats
+
+    for req, resp in zip(reqs, resps):
+        want = _solo_mrc(req)
+        assert np.asarray(resp.mrc).tobytes() == want.tobytes()
+        assert resp.mrc_digest == obs_ledger.mrc_digest(want)
+
+    rows = obs_ledger.read_rows(ledger_path)
+    batched_rows = [r for r in rows if r.get("batch_id")]
+    assert len(batched_rows) == len(reqs)
+    assert len({r["batch_id"] for r in batched_rows}) == 1
+    assert all(r["batch_members"] == len(reqs) for r in batched_rows)
+    agg = obs_ledger.aggregate(rows)["batching"]
+    assert agg["batches"] == 1
+    assert agg["batched_requests"] == len(reqs)
+    assert agg["occupancy_p50"] == len(reqs)
+
+    # satellite 1 payoff: warm repeats on a FRESH service instance
+    # need zero executions for EVERY member
+    tele2 = telemetry.enable()
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"), batch_window_ms=50.0,
+    ) as svc2:
+        warm = [svc2.analyze(r, timeout=120) for r in reqs]
+    telemetry.disable()
+    assert tele2.counters.get("service_exec_started", 0) == 0
+    assert all(w.cache in ("mem", "disk") for w in warm)
+    assert ([w.mrc_digest for w in warm]
+            == [r.mrc_digest for r in resps])
+
+
+def test_batch_max_refs_overflow_splits(tmp_path):
+    """max_refs bounds the merge: four concurrent requests at twice
+    the per-request tracked-ref budget flush as exactly
+    ceil(total_refs / max_refs) = 2 batches / engine executions, and
+    every member still completes."""
+    reqs = [
+        _sampled_req(n=n, ratio=0.2, seed=s)
+        for n, s in ((16, 1), (20, 2), (24, 3), (28, 4))
+    ]
+    refs_per = sum(
+        len(nest.refs) for nest in reqs[0].build_program().nests
+    )
+    tele = telemetry.enable()
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"),
+        batch_window_ms=250.0, batch_max_refs=2 * refs_per,
+    ) as svc:
+        tickets = [svc.submit(r) for r in reqs]
+        resps = [svc.result(t, timeout=300) for t in tickets]
+    telemetry.disable()
+    assert all(r.ok for r in resps)
+    assert tele.counters["batch_members"] == len(reqs)
+    assert tele.counters["batches_formed"] == 2
+    assert tele.counters["service_exec_started"] == 2
+
+
+def test_batch_failure_degrades_members_to_solo(tmp_path):
+    """A blown shared dispatch never fails members collectively: each
+    re-runs down the solo chain and still serves its canonical MRC."""
+    def broken_batch_runner(jobs):
+        raise RuntimeError("shared dispatch exploded")
+
+    reqs = [
+        _sampled_req(n=16, seed=1),
+        _sampled_req(n=20, seed=2),
+    ]
+    tele = telemetry.enable()
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"), batch_window_ms=300.0,
+    ) as svc:
+        svc.executor.batch_runner = broken_batch_runner
+        tickets = [svc.submit(r) for r in reqs]
+        resps = [svc.result(t, timeout=300) for t in tickets]
+        stats = svc.executor.stats()
+    telemetry.disable()
+    assert all(r.ok for r in resps)
+    assert tele.counters["batches_formed"] >= 1
+    assert (tele.counters["service_batch_failed"]
+            == tele.counters["batches_formed"])
+    assert tele.counters["service_batch_fallback_solo"] == len(reqs)
+    assert stats["batch_fallback_solo"] == len(reqs)
+    for req, resp in zip(reqs, resps):
+        want = _solo_mrc(req)
+        assert np.asarray(resp.mrc).tobytes() == want.tobytes()
+
+
+def test_queued_deadline_expires_immediately():
+    """The deadline fix: a member whose deadline passes while it sits
+    in the admission window fails RIGHT THEN (deadline_abandoned),
+    well before the window flushes; its batchmates are unaffected."""
+    doomed = _sampled_req(n=16, seed=1, deadline_s=0.05, id="doomed")
+    fine = _sampled_req(n=20, seed=2, id="fine")
+    tele = telemetry.enable()
+    with AnalysisService(batch_window_ms=500.0) as svc:
+        t_doomed = svc.submit(doomed)
+        t_fine = svc.submit(fine)
+        t0 = time.perf_counter()
+        r_doomed = svc.result(t_doomed, timeout=60)
+        doomed_wait = time.perf_counter() - t0
+        r_fine = svc.result(t_fine, timeout=300)
+    telemetry.disable()
+    assert not r_doomed.ok
+    assert "deadline_abandoned" in r_doomed.error
+    # resolved by the window loop's deadline wake-up, not the flush
+    assert doomed_wait < 0.45
+    assert r_fine.ok
+    assert tele.counters["service_deadline_abandoned"] == 1
+    # only the surviving member rode the batch
+    assert tele.counters.get("batch_members", 0) == 1
+
+
+# -- serving / observability surface ----------------------------------
+
+
+def test_serve_stats_and_ledger_surface_batching(tmp_path, capsys):
+    """serve_jsonl with a batch window: healthz reports the admission
+    queue, the post-batch stats snapshot carries the occupancy/latency
+    counters, and the ledger's batch_id rows survive the offline
+    auditor (check_ledger --stats prints the batching aggregate)."""
+    import io
+
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    svc = AnalysisService(
+        cache_dir=str(tmp_path / "store"), ledger_path=ledger_path,
+        batch_window_ms=60.0,
+    )
+    fin = io.StringIO("\n".join([
+        json.dumps({"id": "h", "type": "healthz"}),
+        json.dumps({"id": "r1", "model": "gemm", "n": 16,
+                    "engine": "sampled", "ratio": 0.3, "seed": 1}),
+        json.dumps({"id": "r2", "model": "gemm", "n": 20,
+                    "engine": "sampled", "ratio": 0.3, "seed": 2}),
+        json.dumps({"id": "s", "type": "stats"}),
+    ]) + "\n")
+    fout = io.StringIO()
+    try:
+        failures = serve_jsonl(svc, fin, fout)
+        post = svc.stats()
+    finally:
+        svc.close()
+    assert failures == 0
+    h, r1, r2, s = [
+        json.loads(ln) for ln in fout.getvalue().splitlines()
+    ]
+    assert h["ok"] and "batch_queue_depth" in h["healthz"]
+    assert r1["ok"] and r2["ok"]
+    # the inline stats line snapshots BEFORE the window flushed; the
+    # batch keys are still present (zero-valued at worst)
+    assert "batches_formed" in s["stats"]["executor"]
+    assert "batching" in s["stats"]
+    # the post-serve snapshot has the real counts: both requests were
+    # submitted before any result was awaited, so they shared a window
+    ex = post["executor"]
+    assert ex["batch_members"] == 2
+    assert ex["batches_formed"] >= 1
+    assert "batch_occupancy_p50" in ex
+    agg = post["batching"]
+    assert agg["batched_requests"] == 2
+    assert agg["batches"] == ex["batches_formed"]
+
+    assert check_ledger.main([ledger_path, "--stats"]) == 0
+    out = capsys.readouterr().out
+    assert "batching:" in out
+
+
+def test_cli_batch_window_flags(tmp_path, capsys):
+    """--batch-window-ms routes one-shot runs through the batching
+    service (needs --cache-dir) and rejects the flag without it."""
+    with pytest.raises(SystemExit):
+        from pluss_sampler_optimization_tpu.cli import main
+        main(["acc", "--model", "gemm", "--n", "16", "--engine",
+              "sampled", "--batch-window-ms", "30"])
+    from pluss_sampler_optimization_tpu.cli import main
+    rc = main([
+        "acc", "--model", "gemm", "--n", "16", "--engine", "sampled",
+        "--cache-dir", str(tmp_path / "store"),
+        "--batch-window-ms", "30", "--batch-max-refs", "8",
+    ])
+    capsys.readouterr()
+    assert rc == 0

@@ -152,6 +152,43 @@ def test_check_dispatch_stats_tool(tmp_path):
     assert check_dispatch_stats.main([str(tmp_path / "absent.json")]) == 1
 
 
+def test_check_dispatch_stats_batched(tmp_path):
+    """A batched (cross-request) run exports ref_buckets_union: the
+    checker bounds the MERGED execution's dispatches by the union
+    bucket plan — K requests must not cost more than one plan's
+    ceiling — and still catches an inflated count."""
+    from pluss_sampler_optimization_tpu import SamplerConfig
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        run_sampled_multi,
+    )
+
+    tele = telemetry.enable()
+    run_sampled_multi([
+        (REGISTRY["gemm"](16), MACHINE,
+         SamplerConfig(ratio=0.25, seed=3), False),
+        (REGISTRY["gemm"](24), MACHINE,
+         SamplerConfig(ratio=0.2, seed=4), False),
+    ])
+    telemetry.disable()
+    path = str(tmp_path / "batched.json")
+    tele.write_json(path)
+    assert check_dispatch_stats.main([path]) == 0
+
+    with open(path) as f:
+        doc = json.load(f)
+    error, note = check_dispatch_stats.check(doc)
+    assert error is None and "union buckets" in note
+    doc["counters"]["dispatches"] = (
+        doc["gauges"]["ref_buckets_union"]
+        * doc["gauges"]["expected_chunks"]
+        + doc["counters"].get("capacity_regrows", 0) + 1
+    )
+    bad = str(tmp_path / "batched_regressed.json")
+    with open(bad, "w") as f:
+        json.dump(doc, f)
+    assert check_dispatch_stats.main([bad]) == 1
+
+
 def test_json_schema_roundtrip(tmp_path):
     tele = telemetry.enable()
     with telemetry.span("stage"):

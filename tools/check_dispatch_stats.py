@@ -46,24 +46,29 @@ def check(doc) -> tuple[str | None, str | None]:
     gauges = doc.get("gauges")
     if not isinstance(counters, dict) or not isinstance(gauges, dict):
         return "missing counters/gauges objects", None
-    buckets = gauges.get("ref_buckets")
+    union = gauges.get("ref_buckets_union")
+    buckets = union if union is not None else gauges.get("ref_buckets")
     chunks = gauges.get("expected_chunks")
     if buckets is None or chunks is None:
         return None, "no fusion gauges (unfused run?) — skipped"
+    # batched (cross-request) runs export ref_buckets_union: the bound
+    # is over the UNION bucket plan, the whole point of merging —
+    # K requests' dispatches must not exceed one union plan's ceiling
+    kind = "union buckets" if union is not None else "buckets"
     dispatches = counters.get("dispatches", 0)
     regrows = counters.get("capacity_regrows", 0)
     bound = buckets * chunks + regrows
     if dispatches > bound:
         return (
             f"dispatches {dispatches:g} exceed the bucket plan's "
-            f"ceiling {bound:g} (ref_buckets {buckets:g} * "
+            f"ceiling {bound:g} ({kind} {buckets:g} * "
             f"expected_chunks {chunks:g} + capacity_regrows "
             f"{regrows:g}) — cross-ref fusion regressed",
             None,
         )
     return None, (
         f"dispatches {dispatches:g} <= {bound:g} "
-        f"({buckets:g} buckets * {chunks:g} chunks + {regrows:g} "
+        f"({buckets:g} {kind} * {chunks:g} chunks + {regrows:g} "
         "regrows)"
     )
 

@@ -18,7 +18,11 @@ is 0; without --gc the exit code is nonzero when anything invalid or
 stale was found, so CI can gate on ledger health.
 
     python tools/check_ledger.py LEDGER.jsonl [--gc]
-        [--max-age-days N] [--max-rows N]
+        [--max-age-days N] [--max-rows N] [--stats]
+
+--stats additionally prints the ledger aggregate over the valid rows
+(the CLI `stats` mode's table, including batch occupancy and
+batched-vs-solo latency joined on batch_id).
 """
 
 from __future__ import annotations
@@ -76,6 +80,10 @@ def main(argv=None) -> int:
                     help="with --gc keep only the newest N rows "
                     "(0 = unbounded); without --gc surplus rows are "
                     "reported")
+    ap.add_argument("--stats", action="store_true",
+                    help="also print the ledger aggregate (per-engine "
+                    "latency/cache table, batch occupancy p50/p95 and "
+                    "batched-vs-solo latency from batch_id rows)")
     args = ap.parse_args(argv)
 
     if not os.path.isfile(args.ledger):
@@ -122,6 +130,11 @@ def main(argv=None) -> int:
         + (f"; compacted to {len(scan['valid'])} rows"
            if args.gc and n_bad else "")
     )
+    if args.stats:
+        from pluss_sampler_optimization_tpu.runtime.obs import ledger
+
+        for line in ledger.format_stats(ledger.aggregate(scan["valid"])):
+            print(line)
     if args.gc:
         return 0
     return 1 if n_bad else 0
