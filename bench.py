@@ -1209,6 +1209,66 @@ def main() -> int:
         except Exception as e:  # never sink the headline metric
             cb["error"] = repr(e)
 
+    # Live-metrics registry overhead: the serve path enables the
+    # rolling registry unconditionally, so its cost on the hot engine
+    # path is a standing claim — median of 3 hot reps with the registry
+    # disabled vs enabled (every telemetry.count/gauge mirrored into
+    # rolling windows) must stay within a 2% wall budget. Also smokes
+    # the SLO sentinel over the accumulated bench ledger.
+    if extras_budget_left("slo_sentinel", extra):
+        so: dict = {}
+        extra["slo_sentinel"] = so
+        try:
+            from pluss_sampler_optimization_tpu.runtime.obs import (
+                metrics as obs_metrics,
+            )
+
+            def med3():
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    timed_engine_run()
+                    ts.append(time.perf_counter() - t0)
+                return sorted(ts)[1]
+
+            timed_engine_run()  # re-warm after the service extras
+            off_s = med3()
+            obs_metrics.enable()
+            try:
+                on_s = med3()
+            finally:
+                obs_metrics.disable()
+            overhead_pct = round(100.0 * (on_s - off_s) / off_s, 2)
+            so["registry_overhead"] = {
+                "engine": args.engine,
+                "disabled_s": round(off_s, 4),
+                "enabled_s": round(on_s, 4),
+                "overhead_pct": overhead_pct,
+                "within_budget": overhead_pct < 2.0,
+                "budget_pct": 2.0,
+            }
+            if args.ledger:
+                lp = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    args.ledger,
+                )
+                if os.path.isfile(lp):
+                    from pluss_sampler_optimization_tpu.runtime.obs import (
+                        ledger as obs_ledger,
+                        slo as obs_slo,
+                    )
+
+                    report = obs_slo.evaluate(
+                        rows=obs_ledger.read_rows(lp)
+                    )
+                    so["ledger_slo"] = {
+                        "ok": report["ok"],
+                        "checks": [c["name"]
+                                   for c in report["checks"]],
+                    }
+        except Exception as e:  # never sink the headline metric
+            so["error"] = repr(e)
+
     if have_counters and "compile_cache" in extra:
         # final snapshot: the extras (periodic_exact, second model) may
         # have compiled too; "total" must mean the whole process

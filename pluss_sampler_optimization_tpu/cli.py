@@ -399,6 +399,54 @@ def main(argv=None) -> int:
         "once its summed tracked-ref count reaches N; overflow "
         "requests start the next batch (default: 64)",
     )
+    ap.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve mode: expose the live metrics registry on "
+        "http://127.0.0.1:PORT/metrics in Prometheus text format "
+        "(counters with rolling 30s/5m windows, gauges, per-stage "
+        "request latency histograms with trace-id exemplars). 0 "
+        "binds an ephemeral port, printed to stderr. The registry "
+        "itself is always on in serve mode; this flag only adds the "
+        "scrape endpoint. See README \"Live metrics & SLOs\".",
+    )
+    ap.add_argument(
+        "--slo-latency-p95-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="serve mode: run the SLO sentinel with a total-latency "
+        "objective — at most 5%% of requests may exceed SECONDS; a "
+        "multi-window burn rate above --slo-burn-threshold in BOTH "
+        "rolling windows emits slo_breach telemetry",
+    )
+    ap.add_argument(
+        "--slo-error-budget",
+        type=float,
+        default=None,
+        metavar="FRACTION",
+        help="serve mode: run the SLO sentinel with an error "
+        "objective — at most FRACTION of requests may fail or "
+        "complete degraded (burn-rate semantics as above)",
+    )
+    ap.add_argument(
+        "--slo-burn-threshold",
+        type=float,
+        default=1.0,
+        metavar="X",
+        help="SLO sentinel burn-rate trip point (default 1.0 = "
+        "budget consumed exactly as fast as the objective allows)",
+    )
+    ap.add_argument(
+        "--slo-interval-s",
+        type=float,
+        default=10.0,
+        metavar="SECONDS",
+        help="SLO sentinel evaluation period (default 10); a final "
+        "evaluation always runs when the serve batch completes",
+    )
     args = ap.parse_args(argv)
 
     if args.list_models:
@@ -414,6 +462,20 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.mode != "serve":
+        if args.metrics_port is not None:
+            raise SystemExit(
+                "--metrics-port exposes the live serving registry; "
+                "it applies to serve mode only"
+            )
+        if (args.slo_latency_p95_s is not None
+                or args.slo_error_budget is not None):
+            raise SystemExit(
+                "--slo-* flags run the serving SLO sentinel; they "
+                "apply to serve mode only (offline ledgers are gated "
+                "by tools/check_slo.py)"
+            )
 
     if args.mode == "serve":
         return _observed(args, lambda: _serve(args))
@@ -584,7 +646,11 @@ def _request_from_args(args, engine):
 
 
 def _serve(args) -> int:
-    """`serve` mode: process a JSONL request batch end to end."""
+    """`serve` mode: process a JSONL request batch end to end, under
+    the live metrics registry (always on here — the `metrics` request
+    type and the optional --metrics-port scrape read it) and the
+    optional SLO sentinel."""
+    from .runtime.obs import metrics as obs_metrics
     from .service import AnalysisService, serve_jsonl
 
     fin = sys.stdin if args.requests == "-" else open(args.requests)
@@ -592,6 +658,18 @@ def _serve(args) -> int:
         sys.stdout if args.responses == "-"
         else open(args.responses, "w")
     )
+    registry = obs_metrics.enable()
+    server = None
+    sentinel = None
+    if args.metrics_port is not None:
+        server = obs_metrics.MetricsServer(
+            registry, port=args.metrics_port
+        )
+        print(
+            f"serve: live metrics on "
+            f"http://{server.host}:{server.port}/metrics",
+            file=sys.stderr,
+        )
     try:
         with AnalysisService(
             cache_dir=args.cache_dir, max_workers=args.max_workers,
@@ -599,8 +677,39 @@ def _serve(args) -> int:
             batch_window_ms=args.batch_window_ms,
             batch_max_refs=args.batch_max_refs,
         ) as svc:
+            if (args.slo_latency_p95_s is not None
+                    or args.slo_error_budget is not None):
+                from .config import SLOConfig
+                from .runtime.obs import slo as obs_slo
+
+                kw = {"burn_rate_threshold": args.slo_burn_threshold}
+                if args.slo_latency_p95_s is not None:
+                    kw["latency_p95_s"] = args.slo_latency_p95_s
+                if args.slo_error_budget is not None:
+                    kw["error_budget"] = args.slo_error_budget
+                sentinel = obs_slo.SLOSentinel(
+                    SLOConfig(**kw), registry=registry,
+                    ledger_path=args.ledger,
+                    interval_s=args.slo_interval_s,
+                ).start()
+                svc.slo_sentinel = sentinel
             failures = serve_jsonl(svc, fin, fout)
+            if sentinel is not None:
+                # short batches finish inside one interval; the final
+                # evaluation guarantees every serve run gets (at
+                # least) one report and any breach events
+                report = sentinel.evaluate_once()
+                if not report["ok"]:
+                    from .runtime.obs import slo as obs_slo
+
+                    for line in obs_slo.format_report(report):
+                        print(f"serve: {line}", file=sys.stderr)
     finally:
+        if sentinel is not None:
+            sentinel.close()
+        if server is not None:
+            server.close()
+        obs_metrics.disable()
         if fin is not sys.stdin:
             fin.close()
         if fout is not sys.stdout:

@@ -18,13 +18,21 @@ once its JSON is written):
   absolute miss-ratio delta) appended to the ledger and gated by
   tools/check_drift.py, so the executor's silent exact→sampled
   degradation has a continuously watched accuracy bound.
+- **metrics** — the LIVE view: a process-global registry of counters,
+  gauges, and rolling-window latency histograms fed by the same
+  telemetry.count/gauge write path, scrapeable in Prometheus text
+  format (`--metrics-port` / the serve `metrics` request).
+- **slo** — the burn-rate sentinel over the registry windows and the
+  ledger tail (latency p95, error/degradation budget, drift status,
+  batch occupancy), emitting `slo_breach` events and gated offline by
+  tools/check_slo.py.
 
 Everything here is observation only: with no ledger path and no export
 flag nothing in this package executes, and engine results are pinned
 bit-identical with observability enabled vs disabled
-(tests/test_obs.py).
+(tests/test_obs.py, tests/test_live_obs.py).
 """
 
-from . import drift, exporters, ledger
+from . import drift, exporters, ledger, metrics, slo
 
-__all__ = ["drift", "exporters", "ledger"]
+__all__ = ["drift", "exporters", "ledger", "metrics", "slo"]

@@ -145,27 +145,72 @@ def prometheus_metric_name(name: str, prefix: str = "pluss_") -> str:
     return out
 
 
+def resolve_prometheus_names(pairs) -> dict:
+    """Collision-safe name assignment. `pairs` is a list of
+    (raw_key, base_name); returns {raw_key: unique_metric_name}.
+
+    Two distinct telemetry names can sanitize to the same Prometheus
+    name (e.g. "cache/hits" and "cache.hits" both become
+    "pluss_cache_hits") — emitting both would silently overwrite one
+    sample in the scraper. When a sanitized name is claimed by more
+    than one raw key, the first key in sorted order keeps the base
+    name and every other key gets a deterministic 8-hex suffix derived
+    from its raw key, so the mapping is stable across processes and
+    insertion orders."""
+    import hashlib
+
+    groups: dict = {}
+    for raw, base in pairs:
+        groups.setdefault(base, []).append(raw)
+    out: dict = {}
+    for base, raws in groups.items():
+        if len(raws) == 1:
+            out[raws[0]] = base
+            continue
+        for i, raw in enumerate(sorted(raws, key=repr)):
+            if i == 0:
+                out[raw] = base
+            else:
+                digest = hashlib.sha1(
+                    repr(raw).encode()
+                ).hexdigest()[:8]
+                out[raw] = f"{base}_{digest}"
+    return out
+
+
 def prometheus_lines(tele_or_doc, prefix: str = "pluss_") -> list[str]:
     """Counters (as `*_total`), numeric gauges, and the run duration
     in text exposition format, sorted by metric name (deterministic
     bytes for a given run). Non-numeric gauges are skipped — the
-    exposition format has no string samples."""
+    exposition format has no string samples. Sanitization collisions
+    get deterministic suffixes (resolve_prometheus_names)."""
     doc = _doc(tele_or_doc)
-    metrics: list[tuple[str, str, float]] = []
-    for name, value in doc.get("counters", {}).items():
-        metrics.append(
-            (prometheus_metric_name(name, prefix) + "_total",
-             "counter", float(value))
+    pairs: list = []
+    for name in doc.get("counters", {}):
+        pairs.append(
+            (("counter", name),
+             prometheus_metric_name(name, prefix) + "_total")
         )
     for name, value in doc.get("gauges", {}).items():
         if isinstance(value, bool) or not isinstance(
             value, (int, float)
         ):
             continue
-        metrics.append(
-            (prometheus_metric_name(name, prefix), "gauge",
-             float(value))
+        pairs.append(
+            (("gauge", name), prometheus_metric_name(name, prefix))
         )
+    names = resolve_prometheus_names(pairs)
+    metrics: list[tuple[str, str, float]] = []
+    for name, value in doc.get("counters", {}).items():
+        metrics.append(
+            (names[("counter", name)], "counter", float(value))
+        )
+    for name, value in doc.get("gauges", {}).items():
+        if isinstance(value, bool) or not isinstance(
+            value, (int, float)
+        ):
+            continue
+        metrics.append((names[("gauge", name)], "gauge", float(value)))
     metrics.append(
         (prefix + "run_duration_seconds", "gauge",
          float(doc.get("duration_s", 0.0)))
@@ -174,6 +219,70 @@ def prometheus_lines(tele_or_doc, prefix: str = "pluss_") -> list[str]:
     for name, mtype, value in sorted(metrics):
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name} {value:g}")
+    return lines
+
+
+def prometheus_registry_lines(registry,
+                              prefix: str = "pluss_") -> list[str]:
+    """Live-registry exposition: counters (with `_total`), numeric
+    gauges, and histograms (cumulative `_bucket{le=...}` series plus
+    `_sum`/`_count`, with OpenMetrics exemplars where a trace id was
+    recorded). Shares the sanitizer and the collision policy with the
+    per-run exporter; accepts a MetricsRegistry or its snapshot()
+    dict."""
+    snap = (registry if isinstance(registry, dict)
+            else registry.snapshot())
+    pairs: list = []
+    for name in snap.get("counters", {}):
+        pairs.append(
+            (("counter", name),
+             prometheus_metric_name(name, prefix) + "_total")
+        )
+    for name, value in snap.get("gauges", {}).items():
+        if isinstance(value, bool) or not isinstance(
+            value, (int, float)
+        ):
+            continue
+        pairs.append(
+            (("gauge", name), prometheus_metric_name(name, prefix))
+        )
+    for name in snap.get("histograms", {}):
+        pairs.append(
+            (("histogram", name), prometheus_metric_name(name, prefix))
+        )
+    names = resolve_prometheus_names(pairs)
+
+    blocks: list[tuple[str, list[str]]] = []
+    for name, value in snap.get("counters", {}).items():
+        out = names[("counter", name)]
+        blocks.append((out, [f"# TYPE {out} counter",
+                             f"{out} {float(value):g}"]))
+    for name, value in snap.get("gauges", {}).items():
+        if isinstance(value, bool) or not isinstance(
+            value, (int, float)
+        ):
+            continue
+        out = names[("gauge", name)]
+        blocks.append((out, [f"# TYPE {out} gauge",
+                             f"{out} {float(value):g}"]))
+    for name, hist in snap.get("histograms", {}).items():
+        out = names[("histogram", name)]
+        body = [f"# TYPE {out} histogram"]
+        exemplars = hist.get("exemplars", {})
+        for le, cum in hist["buckets"].items():
+            line = f'{out}_bucket{{le="{le}"}} {cum}'
+            ex = exemplars.get(le)
+            if ex is not None:
+                line += (f' # {{trace_id="{ex[0]}"}}'
+                         f" {float(ex[1]):g}")
+            body.append(line)
+        body.append(f"{out}_sum {float(hist['sum']):g}")
+        body.append(f"{out}_count {int(hist['count'])}")
+        blocks.append((out, body))
+
+    lines: list[str] = []
+    for _, body in sorted(blocks, key=lambda b: b[0]):
+        lines.extend(body)
     return lines
 
 

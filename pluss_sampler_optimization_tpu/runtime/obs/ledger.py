@@ -47,7 +47,15 @@ import time
 
 from ..io import append_text_line
 
-LEDGER_VERSION = 1
+# v2 (current): request rows gain the trace context (`trace_id`
+# joining the row to its — possibly shared — execution span via
+# `span_id`) plus per-stage timings (`queue_s`, `batch_wait_s`,
+# `execute_s`) and the singleflight `coalesced` join count. All new
+# fields are optional, so v1 rows written by older processes remain
+# valid: readers accept every version in ACCEPTED_VERSIONS, writers
+# stamp LEDGER_VERSION.
+LEDGER_VERSION = 2
+ACCEPTED_VERSIONS = (1, 2)
 
 KINDS = ("request", "drift", "bench")
 
@@ -88,9 +96,9 @@ def validate_row(row) -> list[str]:
     errors: list[str] = []
     if not isinstance(row, dict):
         return ["row is not a JSON object"]
-    if row.get("ledger_version") != LEDGER_VERSION:
+    if row.get("ledger_version") not in ACCEPTED_VERSIONS:
         errors.append(
-            f"ledger_version must be {LEDGER_VERSION}, got "
+            f"ledger_version must be one of {ACCEPTED_VERSIONS}, got "
             f"{row.get('ledger_version')!r}"
         )
     if not _is_num(row.get("ts")) or row.get("ts", -1) < 0:
@@ -144,6 +152,18 @@ def validate_row(row) -> list[str]:
             need_str("batch_id", nullable=True)
         if "batch_members" in row:
             need_num("batch_members", nullable=True)
+        # v2 trace context + per-stage timings: optional in both
+        # versions (a v1 row never carries them; a v2 row may omit
+        # stages that did not apply, e.g. batch_wait for solo runs)
+        if "trace_id" in row:
+            need_str("trace_id", nullable=True)
+        if "span_id" in row:
+            need_str("span_id", nullable=True)
+        for stage in ("queue_s", "batch_wait_s", "execute_s"):
+            if stage in row:
+                need_num(stage, nullable=True)
+        if "coalesced" in row:
+            need_num("coalesced", nullable=True)
     elif kind == "drift":
         need_str("model")
         need_num("n")
@@ -241,10 +261,23 @@ def aggregate(rows: list[dict]) -> dict:
     batches: dict = {}
     lat_batched: list[float] = []
     lat_solo: list[float] = []
+    # unified service counters (the ledger view of the same numbers
+    # the executor's `stats` snapshot and the Prometheus export
+    # report): one row per non-coalesced submit, plus the row's
+    # `coalesced` count for singleflight joiners
+    service = {"submitted": 0, "coalesced": 0, "completed": 0,
+               "failed": 0, "degraded": 0}
     for row in rows:
         kind = row["kind"]
         by_kind[kind] = by_kind.get(kind, 0) + 1
         if kind == "request":
+            if row.get("source") == "service":
+                joiners = int(row.get("coalesced") or 0)
+                service["submitted"] += 1 + joiners
+                service["coalesced"] += joiners
+                service["completed" if row["ok"] else "failed"] += 1
+                if row.get("degraded"):
+                    service["degraded"] += 1
             bid = row.get("batch_id")
             if bid is not None:
                 b = batches.setdefault(bid, {"rows": 0, "members": 0})
@@ -314,6 +347,7 @@ def aggregate(rows: list[dict]) -> dict:
         ],
         "bench_rows": bench,
         "batching": batching,
+        "service": service,
     }
 
 
@@ -363,6 +397,15 @@ def format_stats(agg: dict) -> list[str]:
                 b["batches"], b["batched_requests"],
                 b["occupancy_p50"], b["occupancy_p95"],
                 b["batched_p50_latency_s"], b["solo_p50_latency_s"],
+            )
+        )
+    svc = agg.get("service")
+    if svc and svc["submitted"]:
+        lines.append(
+            "service: submitted=%d coalesced=%d completed=%d "
+            "failed=%d degraded=%d" % (
+                svc["submitted"], svc["coalesced"], svc["completed"],
+                svc["failed"], svc["degraded"],
             )
         )
     if agg["bench_rows"]:

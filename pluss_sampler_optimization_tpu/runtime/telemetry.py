@@ -76,6 +76,25 @@ _lock = threading.Lock()
 _tls = threading.local()
 _current: "Telemetry | None" = None
 
+# Live metrics sink (runtime/obs/metrics.py registry) — when set by
+# metrics.enable(), count()/gauge() mirror every write into it, so the
+# per-run Telemetry and the live serving registry are two views of one
+# write path. Kept as a bare module global read on the hot path: the
+# disabled cost is one load + None check per call.
+_metrics_sink = None
+
+
+def set_metrics_sink(sink) -> None:
+    """Install (or with None, remove) the live metrics sink. Called by
+    runtime.obs.metrics.enable()/disable(); the sink needs `inc(name,
+    v)` and `set_gauge(name, v)`."""
+    global _metrics_sink
+    _metrics_sink = sink
+
+
+def metrics_sink():
+    return _metrics_sink
+
 
 class _NullSpan:
     """Shared no-op span: the entire disabled-telemetry hot path."""
@@ -353,12 +372,18 @@ def count(name: str, inc: float = 1) -> None:
     tele = _current
     if tele is not None:
         tele.count(name, inc)
+    sink = _metrics_sink
+    if sink is not None:
+        sink.inc(name, inc)
 
 
 def gauge(name: str, value) -> None:
     tele = _current
     if tele is not None:
         tele.gauge(name, value)
+    sink = _metrics_sink
+    if sink is not None:
+        sink.set_gauge(name, value)
 
 
 def event(name: str, **data) -> None:
@@ -414,7 +439,10 @@ def counted_lru_cache(maxsize: int = 128,
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            if _current is None:
+            # count() below feeds both the run and the live registry;
+            # skip the cache_info bookkeeping only when neither view
+            # is listening.
+            if _current is None and _metrics_sink is None:
                 return cached(*args, **kwargs)
             before = cached.cache_info().hits
             out = cached(*args, **kwargs)

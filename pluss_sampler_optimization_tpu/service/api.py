@@ -22,15 +22,19 @@ JSON, unknown fields, a bad model — is a structured error response
 for that line (with the request `id` echoed whenever the line parsed
 far enough to carry one), never a crash of the batch.
 
-Two introspection request types ride the same protocol:
+Three introspection request types ride the same protocol:
 
     {"id": "h1", "type": "healthz"}   -> liveness + engine roster
     {"id": "s1", "type": "stats"}     -> executor queue depth /
         in-flight / coalesce counters, cache tier stats, ledger tail
+    {"id": "m1", "type": "metrics"}   -> live metrics registry
+        snapshot (rolling-window counters, gauges, per-stage request
+        histograms, Prometheus text, latest SLO report)
 
-Both answer from the service's instance-local counters (no telemetry
-run required) with the snapshot taken at the moment the line is READ
-— a mid-batch `stats` line observes the requests submitted before it.
+All answer from the service's instance-local counters / the live
+registry (no telemetry run required) with the snapshot taken at the
+moment the line is READ — a mid-batch `stats` line observes the
+requests submitted before it.
 """
 
 from __future__ import annotations
@@ -55,10 +59,12 @@ from .fingerprint import request_fingerprint
 
 @dataclasses.dataclass(frozen=True)
 class AnalysisRequest:
-    """One analysis request. `id` and `deadline_s` are serving
-    metadata — they identify/bound the request but do not change the
-    result, so they stay OUT of the fingerprint and the stored record.
-    """
+    """One analysis request. `id`, `deadline_s`, and `trace_id` are
+    serving metadata — they identify/bound the request but do not
+    change the result, so they stay OUT of the fingerprint and the
+    stored record. A caller-supplied `trace_id` propagates through
+    singleflight coalescing and batching into the execution span and
+    the ledger row; when absent the executor mints one at submit."""
 
     model: str
     n: int = 128
@@ -82,6 +88,7 @@ class AnalysisRequest:
     pipeline_depth: int | None = None
     deadline_s: float | None = None
     id: str | None = None
+    trace_id: str | None = None
 
     def __post_init__(self) -> None:
         if self.engine not in SERVICE_ENGINES:
@@ -125,6 +132,7 @@ class AnalysisRequest:
         d = dataclasses.asdict(self)
         d.pop("id")
         d.pop("deadline_s")
+        d.pop("trace_id")
         return d
 
     def fingerprint(self, program: Program | None = None) -> str:
@@ -161,6 +169,12 @@ class AnalysisResponse:
     dump_lines: list | None
     per_ref_lines: list | None
     error: str | None
+    # trace context: trace_id identifies the request end to end;
+    # span_id the (possibly shared — batching/singleflight) engine
+    # execution that produced the result. Both null for pure cache
+    # hits with no execution.
+    trace_id: str | None = None
+    span_id: str | None = None
 
     def to_jsonl_dict(self) -> dict:
         """The wire form `serve` emits: compact — the MRC ships in the
@@ -180,6 +194,10 @@ class AnalysisResponse:
             "total_accesses": self.total_accesses,
             "access_label": self.access_label,
         }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
@@ -206,6 +224,8 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             mrc_digest=None, rih=None, dump_lines=None,
             per_ref_lines=None,
             error=outcome.get("error") or "execution failed",
+            trace_id=outcome.get("trace_id"),
+            span_id=outcome.get("span_id"),
         )
     return AnalysisResponse(
         id=request.id,
@@ -224,6 +244,8 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         dump_lines=list(record["dump_lines"]),
         per_ref_lines=list(record.get("per_ref_lines", [])) or None,
         error=None,
+        trace_id=outcome.get("trace_id"),
+        span_id=outcome.get("span_id"),
     )
 
 
@@ -241,6 +263,10 @@ class AnalysisService:
 
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
         self.ledger_path = ledger_path
+        # optional runtime/obs/slo.py sentinel, attached by the CLI
+        # serve mode so the `metrics` request can report the latest
+        # SLO evaluation alongside the registry snapshot
+        self.slo_sentinel = None
         self.executor = RequestExecutor(
             self.cache, max_workers=max_workers, runner=runner,
             ledger_path=ledger_path,
@@ -295,6 +321,24 @@ class AnalysisService:
                 out["batching"] = None
         return out
 
+    def metrics(self) -> dict:
+        """Live-registry snapshot (the `metrics` request type):
+        counters with rolling windows, gauges, per-stage request
+        histograms, the Prometheus exposition text, and — when a
+        sentinel is attached — the latest SLO report. `enabled: false`
+        when no registry is installed (metrics.enable() not called)."""
+        from ..runtime.obs import metrics as obs_metrics
+
+        reg = obs_metrics.get()
+        if reg is None:
+            return {"enabled": False}
+        out = {"enabled": True}
+        out.update(reg.snapshot())
+        out["prometheus"] = reg.prometheus_text()
+        if self.slo_sentinel is not None:
+            out["slo"] = self.slo_sentinel.last_report
+        return out
+
     def submit(self, request: AnalysisRequest) -> AnalysisTicket:
         """Validate, fingerprint, and schedule (or join) a request.
         Raises ValueError/KeyError for malformed requests — `serve`
@@ -328,7 +372,7 @@ class AnalysisService:
         self.close()
 
 
-CONTROL_TYPES = ("healthz", "stats")
+CONTROL_TYPES = ("healthz", "stats", "metrics")
 
 
 def parse_request_line(line: str) -> AnalysisRequest:
@@ -366,7 +410,10 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     (`ok: false`, `line`, `error`) with the request `id` echoed
     whenever the line parsed far enough to carry one. `healthz` /
     `stats` lines (CONTROL_TYPES) answer inline from the service's
-    introspection snapshot taken as the line is read.
+    introspection snapshot taken as the line is read; `metrics` lines
+    snapshot at response time instead, after every request line above
+    them has been awaited, so the live histograms they report are
+    deterministic within a batch.
     """
     # each entry: {"line", "id", and one of "ticket"+"request" |
     # "control" | "error"}
@@ -394,14 +441,20 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
                     f"(have {', '.join(CONTROL_TYPES)})"
                 )
                 continue
+            if kind == "metrics":
+                # deferred to the response pass: every request line
+                # ABOVE this one has been awaited by then, so the
+                # live snapshot deterministically includes their
+                # stage histograms (read-time snapshots would race
+                # with worker completion)
+                entry["control"] = {"type": kind, "payload": None}
+                continue
             try:
-                entry["control"] = {
-                    "type": kind,
-                    "payload": (
-                        service.healthz() if kind == "healthz"
-                        else service.stats()
-                    ),
-                }
+                payload = (
+                    service.healthz() if kind == "healthz"
+                    else service.stats()
+                )
+                entry["control"] = {"type": kind, "payload": payload}
             except Exception as e:
                 entry["error"] = f"introspection failed: {e!r}"
             continue
@@ -414,11 +467,18 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     failures = 0
     for entry in entries:
         if "control" in entry:
+            payload = entry["control"]["payload"]
+            if entry["control"]["type"] == "metrics":
+                try:
+                    payload = service.metrics()
+                except Exception as e:
+                    payload = {"enabled": False,
+                               "error": f"introspection failed: {e!r}"}
             doc = {
                 "id": entry["id"],
                 "ok": True,
                 "type": entry["control"]["type"],
-                entry["control"]["type"]: entry["control"]["payload"],
+                entry["control"]["type"]: payload,
             }
         elif "ticket" in entry:
             try:

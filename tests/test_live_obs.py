@@ -1,0 +1,628 @@
+"""Live serving observability: the rolling metrics registry and its
+scrape surface, end-to-end trace propagation through singleflight and
+the batch scheduler, the ledger v2 trace/stage columns, and the SLO
+burn-rate sentinel plus its offline gate (tools/check_slo.py).
+
+The ISSUE-9 acceptance invariants are pinned here: serve mode exposes
+a live Prometheus scrape whose per-stage histograms populate under a
+concurrent batched workload; every ledger row carries a trace_id
+joining it to its (possibly shared) execution span; the three counter
+surfaces (serve `stats`, the registry/Prometheus export, and
+check_ledger --stats) agree on submitted/coalesced/completed/failed/
+degraded over one workload; the SLO gate exits nonzero on an injected
+latency breach and zero on a healthy run; and MRC outputs are
+byte-identical with the registry enabled vs disabled.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.config import SLOConfig
+from pluss_sampler_optimization_tpu.models import REGISTRY
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
+from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
+from pluss_sampler_optimization_tpu.runtime.obs import (
+    exporters,
+    ledger as obs_ledger,
+    metrics as obs_metrics,
+    slo as obs_slo,
+)
+from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisRequest,
+    AnalysisService,
+    serve_jsonl,
+)
+from pluss_sampler_optimization_tpu.service.executor import (
+    default_runner,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+import check_ledger  # noqa: E402
+import check_slo  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    telemetry.disable()
+    obs_metrics.disable()
+    yield
+    telemetry.disable()
+    obs_metrics.disable()
+
+
+def _req(**kw):
+    base = dict(model="gemm", n=16, engine="oracle")
+    base.update(kw)
+    return AnalysisRequest(**base)
+
+
+# -- registry instruments ---------------------------------------------
+
+
+def test_registry_counters_windows_and_expiry():
+    reg = obs_metrics.MetricsRegistry()
+    t0 = 1000.0
+    reg.inc("reqs", 3, now=t0)
+    reg.inc("reqs", 2, now=t0 + 1.0)
+    reg.set_gauge("depth", 7)
+    assert reg.counter("reqs") == 5
+    assert reg.gauge_value("depth") == 7
+    assert reg.counter_window("reqs", "30s", now=t0 + 1.0) == 5
+    assert reg.counter_window("reqs", "5m", now=t0 + 1.0) == 5
+    # the 30s ring expires, the lifetime total and 5m window persist
+    assert reg.counter_window("reqs", "30s", now=t0 + 40.0) == 0
+    assert reg.counter_window("reqs", "5m", now=t0 + 40.0) == 5
+    assert reg.counter_window("reqs", "5m", now=t0 + 400.0) == 0
+    assert reg.counter("reqs") == 5
+    assert reg.counter("never_written") == 0.0
+    with pytest.raises(KeyError):
+        reg.counter_window("reqs", "2h", now=t0)
+
+
+def test_rolling_histogram_quantiles_fractions_and_expiry():
+    reg = obs_metrics.MetricsRegistry()
+    t0 = 2000.0
+    for v in (0.002, 0.002, 0.02, 0.02, 0.02, 0.02, 0.02, 2.0):
+        reg.observe("lat", v, now=t0)
+    # p50 lands in the (0.01, 0.025] bucket; interpolation keeps it
+    # inside the bucket bounds
+    p50 = reg.histogram_quantile("lat", "30s", 0.50, now=t0)
+    assert 0.01 < p50 <= 0.025
+    # exactly 1/8 of observations sit above 1s
+    frac = reg.histogram_fraction_over("lat", "30s", 1.0, now=t0)
+    assert abs(frac - 1 / 8) < 1e-9
+    assert reg.histogram_fraction_over("lat", "30s", 100.0, now=t0) \
+        <= 1 / 8
+    # window expiry: 30s empties (None), lifetime snapshot persists
+    assert reg.histogram_quantile("lat", "30s", 0.5,
+                                  now=t0 + 60.0) is None
+    snap = reg.snapshot(now=t0)["histograms"]["lat"]
+    assert snap["count"] == 8
+    assert snap["buckets"]["+Inf"] == 8
+    assert snap["buckets"]["0.0025"] == 2
+    assert snap["windows"]["30s"]["count"] == 8
+    # absent histogram reads as None, not an error
+    assert reg.histogram_quantile("nope", "30s", 0.5) is None
+    assert reg.histogram_fraction_over("nope", "30s", 1.0) is None
+
+
+def test_prometheus_registry_text_histograms_and_exemplars():
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("service_submitted", 4)
+    reg.set_gauge("service_queue_depth", 2)
+    reg.observe("request_total_s", 0.02, exemplar="deadbeefcafe0123")
+    text = reg.prometheus_text()
+    assert "# TYPE pluss_service_submitted_total counter" in text
+    assert "pluss_service_submitted_total 4" in text
+    assert "pluss_service_queue_depth 2" in text
+    assert "# TYPE pluss_request_total_s histogram" in text
+    # cumulative buckets: everything at and above 0.025 counts the obs
+    assert 'pluss_request_total_s_bucket{le="0.025"} 1' in text
+    assert 'pluss_request_total_s_bucket{le="+Inf"} 1' in text
+    assert 'pluss_request_total_s_bucket{le="0.01"} 0' in text
+    assert "pluss_request_total_s_count 1" in text
+    # the exemplar joins the bucket to the trace
+    assert '# {trace_id="deadbeefcafe0123"} 0.02' in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_name_collisions_suffix_deterministically():
+    # two raw telemetry names that sanitize identically must not
+    # overwrite each other in the exposition
+    pairs = [(("counter", "cache/hits"), "pluss_cache_hits_total"),
+             (("counter", "cache.hits"), "pluss_cache_hits_total"),
+             (("counter", "other"), "pluss_other_total")]
+    names = exporters.resolve_prometheus_names(pairs)
+    assert names[("counter", "other")] == "pluss_other_total"
+    vals = {names[("counter", "cache/hits")],
+            names[("counter", "cache.hits")]}
+    assert len(vals) == 2
+    assert "pluss_cache_hits_total" in vals
+    suffixed = next(v for v in vals if v != "pluss_cache_hits_total")
+    assert suffixed.startswith("pluss_cache_hits_total_")
+    assert len(suffixed.rsplit("_", 1)[1]) == 8
+    # deterministic across calls and insertion orders
+    assert exporters.resolve_prometheus_names(list(reversed(pairs))) \
+        == names
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("cache/hits", 1)
+    reg.inc("cache.hits", 2)
+    text = reg.prometheus_text()
+    emitted = [ln.split()[0] for ln in text.splitlines()
+               if ln and not ln.startswith("#")]
+    assert len(emitted) == len(set(emitted))
+    assert sum(
+        1 for n in emitted if n.startswith("pluss_cache_hits_total")
+    ) == 2
+
+
+def test_telemetry_write_path_feeds_registry_without_a_run():
+    """count/gauge/counted_lru_cache mirror into the live registry
+    even when no per-run Telemetry is enabled — the two views share
+    one write path."""
+    reg = obs_metrics.enable()
+    calls = []
+
+    @telemetry.counted_lru_cache(maxsize=8, counter="live_test_cache")
+    def f(x):
+        calls.append(x)
+        return x * 2
+
+    assert f(3) == 6 and f(3) == 6
+    telemetry.count("live_only", 5)
+    telemetry.gauge("live_gauge", 1.5)
+    assert reg.counter("live_only") == 5
+    assert reg.gauge_value("live_gauge") == 1.5
+    assert reg.counter("live_test_cache_hits") == 1
+    assert reg.counter("live_test_cache_misses") == 1
+    assert len(calls) == 1
+    obs_metrics.disable()
+    telemetry.count("live_only", 5)  # no sink: must not blow up
+    assert reg.counter("live_only") == 5  # and the old registry froze
+
+
+def test_metrics_server_scrapes_live_registry():
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("scrape_me", 9)
+    with obs_metrics.MetricsServer(reg, port=0) as srv:
+        assert srv.port > 0
+        url = f"http://{srv.host}:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+            body = resp.read().decode()
+        assert "pluss_scrape_me_total 9" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/nope", timeout=10
+            )
+    # after close() the port no longer answers
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url, timeout=0.5)
+
+
+# -- serve surface ----------------------------------------------------
+
+
+def test_serve_metrics_request_reports_live_state(tmp_path):
+    """The `metrics` control line: disabled → {"enabled": false};
+    enabled → counters, rolling windows, per-stage histograms, and
+    the Prometheus text, reflecting the batch's own submissions."""
+    svc = AnalysisService(cache_dir=str(tmp_path / "store"))
+    fin = io.StringIO(json.dumps({"id": "m", "type": "metrics"}) + "\n")
+    fout = io.StringIO()
+    try:
+        assert serve_jsonl(svc, fin, fout) == 0
+    finally:
+        svc.close()
+    line = json.loads(fout.getvalue())
+    assert line["ok"] and line["metrics"] == {"enabled": False}
+
+    obs_metrics.enable()
+    svc = AnalysisService(cache_dir=str(tmp_path / "store2"))
+    fin = io.StringIO("\n".join([
+        json.dumps({"id": "r1", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "m", "type": "metrics"}),
+    ]) + "\n")
+    fout = io.StringIO()
+    try:
+        assert serve_jsonl(svc, fin, fout) == 0
+    finally:
+        svc.close()
+    r1, m = [json.loads(ln) for ln in fout.getvalue().splitlines()]
+    assert r1["ok"] and r1["trace_id"] and r1["span_id"]
+    payload = m["metrics"]
+    assert payload["enabled"] is True
+    assert payload["counters"]["service_submitted"] == 1
+    assert payload["counter_windows"]["service_submitted"]["30s"] == 1
+    hist = payload["histograms"]["request_total_s"]
+    assert hist["count"] == 1
+    assert hist["windows"]["30s"]["count"] == 1
+    assert "pluss_service_submitted_total 1" in payload["prometheus"]
+    assert "pluss_request_total_s_bucket" in payload["prometheus"]
+
+
+def test_three_counter_surfaces_agree_on_one_workload(tmp_path, capsys):
+    """Satellite 1: serve `stats`, the live registry, and
+    check_ledger --stats report IDENTICAL submitted/coalesced/
+    completed/failed/degraded over a workload that exercises
+    coalescing and degradation."""
+    release = threading.Event()
+
+    def runner(engine, program, machine, request):
+        if engine == "exact":
+            raise RuntimeError("exact exploded")
+        release.wait(timeout=30)
+        return default_runner(engine, program, machine, request)
+
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    reg = obs_metrics.enable()
+    with AnalysisService(max_workers=4, runner=runner,
+                         ledger_path=ledger_path) as svc:
+        # two identical submissions: the second must join in flight
+        # (the worker is parked on the event)
+        t1 = svc.submit(_req())
+        deadline = time.time() + 30
+        while not svc.executor._inflight and time.time() < deadline:
+            time.sleep(0.01)
+        t2 = svc.submit(_req())
+        release.set()
+        r1 = svc.result(t1, timeout=60)
+        r2 = svc.result(t2, timeout=60)
+        # one degraded completion: exact fails, the chain lands it
+        r3 = svc.analyze(_req(model="gemm", n=8, engine="exact",
+                              ratio=0.3), timeout=120)
+        stats = svc.executor.stats()
+    assert r1.ok and r2.ok and r3.ok and r3.degraded
+    assert r1.fingerprint == r2.fingerprint
+
+    want = {"submitted": 3, "coalesced": 1, "completed": 2,
+            "failed": 0, "degraded": 1}
+    assert {k: stats[k] for k in want} == want
+    assert {k: int(reg.counter(f"service_{k}")) for k in want} == want
+    agg = obs_ledger.aggregate(obs_ledger.read_rows(ledger_path))
+    assert {k: agg["service"][k] for k in want} == want
+    # and the CLI auditor prints the same line
+    assert check_ledger.main([ledger_path, "--stats"]) == 0
+    out = capsys.readouterr().out
+    assert ("service: submitted=3 coalesced=1 completed=2 "
+            "failed=0 degraded=1") in out
+
+
+# -- trace propagation ------------------------------------------------
+
+
+def test_singleflight_joiners_share_trace_and_ledger_row(tmp_path):
+    """N identical concurrent requests: one execution, one ledger row
+    whose trace_id/span_id every response shares, and the row counts
+    its joiners."""
+    release = threading.Event()
+
+    def slow_runner(engine, program, machine, request):
+        release.wait(timeout=30)
+        return default_runner(engine, program, machine, request)
+
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    obs_metrics.enable()
+    with AnalysisService(max_workers=4, runner=slow_runner,
+                         ledger_path=ledger_path) as svc:
+        first = svc.submit(_req())
+        deadline = time.time() + 30
+        while not svc.executor._inflight and time.time() < deadline:
+            time.sleep(0.01)
+        rest = [svc.submit(_req()) for _ in range(3)]
+        release.set()
+        resps = [svc.result(t, timeout=60) for t in [first] + rest]
+    assert all(r.ok for r in resps)
+    assert len({r.trace_id for r in resps}) == 1
+    assert len({r.span_id for r in resps}) == 1
+    assert resps[0].trace_id and resps[0].span_id
+
+    rows = [r for r in obs_ledger.read_rows(ledger_path)
+            if r["kind"] == "request"]
+    assert len(rows) == 1
+    assert rows[0]["trace_id"] == resps[0].trace_id
+    assert rows[0]["span_id"] == resps[0].span_id
+    assert rows[0]["coalesced"] == 3
+    assert rows[0]["queue_s"] >= 0
+
+
+def test_batched_members_share_execution_span(tmp_path):
+    """N distinct batched requests: each response/row keeps its own
+    trace_id but all join ONE execution span; rows carry the
+    per-stage timings; the per-stage histograms populate; exemplars
+    surface real trace ids in the scrape text."""
+    reqs = [
+        AnalysisRequest(model=m, n=n, engine="sampled", ratio=0.3,
+                        seed=s)
+        for m, n, s in (("gemm", 24, 5), ("gemm", 32, 7),
+                        ("2mm", 12, 11))
+    ]
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    reg = obs_metrics.enable()
+    tele = telemetry.enable()
+    with AnalysisService(cache_dir=str(tmp_path / "store"),
+                         ledger_path=ledger_path,
+                         batch_window_ms=400.0) as svc:
+        tickets = [svc.submit(r) for r in reqs]
+        resps = [svc.result(t, timeout=300) for t in tickets]
+    telemetry.disable()
+    assert all(r.ok for r in resps)
+    assert tele.counters.get("batches_formed") == 1
+    assert len({r.trace_id for r in resps}) == len(reqs)
+    assert len({r.span_id for r in resps}) == 1
+    span_id = resps[0].span_id
+    # the shared execution span carries the same span_id attribute
+    exec_spans = tele.find_spans("service_exec")
+    assert [s.attrs.get("span_id") for s in exec_spans] == [span_id]
+
+    rows = [r for r in obs_ledger.read_rows(ledger_path)
+            if r["kind"] == "request"]
+    assert len(rows) == len(reqs)
+    assert {r["span_id"] for r in rows} == {span_id}
+    assert ({r["trace_id"] for r in rows}
+            == {r.trace_id for r in resps})
+    for row in rows:
+        assert row["ledger_version"] == 2
+        assert row["batch_wait_s"] >= 0
+        assert row["queue_s"] >= 0
+        assert row["execute_s"] > 0
+
+    snap = reg.snapshot()["histograms"]
+    for name in ("request_total_s", "request_batch_wait_s",
+                 "request_execute_s", "request_queue_s"):
+        assert snap[name]["count"] == len(reqs), name
+    text = reg.prometheus_text()
+    for r in resps:
+        assert f'trace_id="{r.trace_id}"' in text
+
+
+def test_ledger_v1_rows_still_validate_v2_is_stamped(tmp_path):
+    """Satellite 3 migration: pre-existing v1 rows stay valid, new
+    appends stamp v2, and the v2 trace/stage columns are
+    type-checked."""
+    v1 = {
+        "ledger_version": 1, "ts": 1.0, "kind": "request",
+        "source": "service", "ok": True, "engine_requested": "oracle",
+        "engine_used": "oracle", "model": "gemm", "n": 16,
+        "latency_s": 0.01, "cache": "miss", "degraded": [],
+        "fingerprint": "f" * 64, "mrc_digest": None,
+    }
+    assert obs_ledger.validate_row(v1) == []
+    v2 = dict(v1, ledger_version=2, trace_id="t" * 16,
+              span_id="s" * 16, queue_s=0.001, batch_wait_s=0.002,
+              execute_s=0.05, coalesced=2)
+    assert obs_ledger.validate_row(v2) == []
+    assert obs_ledger.validate_row(dict(v2, trace_id=5)) != []
+    assert obs_ledger.validate_row(dict(v2, execute_s="slow")) != []
+    assert obs_ledger.validate_row(dict(v1, ledger_version=3)) != []
+
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(v1) + "\n")
+    stamped = obs_ledger.append(path, {
+        k: v for k, v in v1.items()
+        if k not in ("ledger_version", "ts")
+    })
+    assert stamped["ledger_version"] == obs_ledger.LEDGER_VERSION == 2
+    rows = obs_ledger.read_rows(path)
+    assert [r["ledger_version"] for r in rows] == [1, 2]
+    assert obs_ledger.aggregate(rows)["rows"] == 2
+
+
+def test_mrc_bit_identical_with_registry_enabled(tmp_path):
+    """The acceptance bit-identity check: enabling the live registry
+    must not perturb engine numerics."""
+    prog = REGISTRY["gemm"](16)
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=3)
+
+    def mrc_bytes():
+        state, _ = run_sampled(prog, machine, cfg)
+        T = machine.thread_num
+        return aet_mrc(
+            cri_distribute(state, T, T), machine
+        ).tobytes()
+
+    off = mrc_bytes()
+    obs_metrics.enable()
+    on = mrc_bytes()
+    obs_metrics.disable()
+    assert on == off
+    assert np.frombuffer(off, dtype=np.float64).size > 0
+
+
+# -- SLO sentinel -----------------------------------------------------
+
+
+def test_burn_check_requires_both_windows():
+    mk = obs_slo._burn_check
+    assert mk("x", {"30s": 0.5, "5m": 0.5}, 0.05, 1.0, {})["ok"] \
+        is False  # burn 10 in both
+    # fast-window spike alone is not a breach
+    assert mk("x", {"30s": 0.5, "5m": 0.0}, 0.05, 1.0, {})["ok"]
+    # no evidence anywhere: healthy
+    assert mk("x", {"30s": None, "5m": None}, 0.05, 1.0, {})["ok"]
+    assert mk("x", {}, 0.05, 1.0, {})["ok"]
+    burn = mk("x", {"30s": 0.5, "5m": None}, 0.05, 1.0, {})
+    assert burn["ok"] and burn["burn"]["30s"] == 10.0
+
+
+def test_slo_sentinel_registry_breach_and_events():
+    reg = obs_metrics.enable()
+    tele = telemetry.enable()
+    now = 5000.0
+    for _ in range(20):
+        reg.observe("request_total_s", 0.8, now=now)
+        reg.inc("service_submitted", now=now)
+    config = SLOConfig(latency_p95_s=0.1, error_budget=0.5)
+    sentinel = obs_slo.SLOSentinel(config, registry=reg)
+    report = sentinel.evaluate_once(now=now)
+    telemetry.disable()
+    assert report["ok"] is False
+    by_name = {c["name"]: c for c in report["checks"]}
+    lat = by_name["latency_p95"]
+    assert not lat["ok"]
+    assert all(b > 1.0 for b in lat["burn"].values())
+    assert by_name["error_budget"]["ok"]  # nothing failed
+    assert sentinel.last_report is report
+    assert tele.counters.get("slo_evaluations") == 1
+    assert tele.counters.get("slo_breach") == 1
+    ev = [e for e in tele.events if e["name"] == "slo_breach"]
+    assert ev and ev[0]["check"] == "latency_p95"
+    assert ev[0]["burn_30s"] > 1.0
+    # the breach itself is scrapeable: the counter mirrored back in
+    assert reg.counter("slo_breach") == 1
+    lines = obs_slo.format_report(report)
+    assert any("latency_p95: BREACH" in ln for ln in lines)
+    assert lines[-1] == "slo overall: BREACH"
+
+    # healthy run: fast requests, no breach, no event
+    reg2 = obs_metrics.enable()
+    for _ in range(20):
+        reg2.observe("request_total_s", 0.01, now=now)
+        reg2.inc("service_submitted", now=now)
+    healthy = obs_slo.SLOSentinel(config, registry=reg2)
+    assert healthy.evaluate_once(now=now)["ok"]
+
+
+def test_slo_sentinel_background_thread_runs():
+    reg = obs_metrics.enable()
+    tele = telemetry.enable()
+    sentinel = obs_slo.SLOSentinel(
+        SLOConfig(error_budget=0.5), registry=reg, interval_s=0.05
+    ).start()
+    deadline = time.time() + 10
+    while (tele.counters.get("slo_evaluations", 0) < 2
+           and time.time() < deadline):
+        time.sleep(0.01)
+    sentinel.close()
+    telemetry.disable()
+    assert tele.counters.get("slo_evaluations", 0) >= 2
+    assert sentinel.last_report is not None
+    assert sentinel.last_report["ok"]
+
+
+def _ledger_with_latencies(path, latencies, ts=10_000.0):
+    for i, lat in enumerate(latencies):
+        obs_ledger.append(path, {
+            "ts": ts + i * 0.001, "kind": "request",
+            "source": "service", "ok": True,
+            "engine_requested": "sampled", "engine_used": "sampled",
+            "model": "gemm", "n": 16, "latency_s": lat,
+            "cache": "miss", "degraded": [], "fingerprint": None,
+            "mrc_digest": None,
+        })
+
+
+def test_check_slo_gate_exit_codes(tmp_path, capsys):
+    """Satellite 6 / acceptance: the offline gate trips on an
+    injected latency breach and stays green on a healthy ledger."""
+    healthy = str(tmp_path / "healthy.jsonl")
+    _ledger_with_latencies(healthy, [0.01] * 12)
+    assert check_slo.main([healthy, "--latency-p95-s", "1.0"]) == 0
+    out = capsys.readouterr().out
+    assert "slo latency_p95: ok" in out
+    assert "slo overall: ok" in out
+
+    slow = str(tmp_path / "slow.jsonl")
+    _ledger_with_latencies(slow, [2.0] * 12)
+    assert check_slo.main([slow, "--latency-p95-s", "0.1"]) == 1
+    out = capsys.readouterr().out
+    assert "slo latency_p95: BREACH" in out
+    assert "slo overall: BREACH" in out
+    # without a latency objective the same ledger is inside budget
+    assert check_slo.main([slow]) == 0
+    capsys.readouterr()
+
+    # degraded completions burn the error budget
+    bad = str(tmp_path / "bad.jsonl")
+    _ledger_with_latencies(bad, [0.01] * 4)
+    obs_ledger.append(bad, {
+        "ts": 10_000.5, "kind": "request", "source": "service",
+        "ok": True, "engine_requested": "exact",
+        "engine_used": "sampled", "model": "gemm", "n": 16,
+        "latency_s": 0.01, "cache": "miss",
+        "degraded": [{"from": "exact", "to": "sampled",
+                      "reason": "x"}],
+        "fingerprint": None, "mrc_digest": None,
+    })
+    assert check_slo.main([bad, "--error-budget", "0.01"]) == 1
+    assert check_slo.main([bad, "--error-budget", "0.5"]) == 0
+    capsys.readouterr()
+
+    assert check_slo.main([str(tmp_path / "missing.jsonl")]) == 1
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert check_slo.main([empty]) == 0
+    capsys.readouterr()
+
+
+# -- CLI surface ------------------------------------------------------
+
+
+def test_cli_rejects_live_flags_outside_serve(tmp_path):
+    base = ["acc", "--model", "gemm", "--n", "8", "--engine",
+            "oracle"]
+    with pytest.raises(SystemExit):
+        main(base + ["--metrics-port", "0"])
+    with pytest.raises(SystemExit):
+        main(base + ["--slo-latency-p95-s", "1.0"])
+
+
+def test_cli_serve_scrape_endpoint_and_slo(tmp_path, capsys):
+    """serve --metrics-port 0: the scrape URL is announced on stderr
+    and (scraped mid-run via a metrics control line) exposes the
+    per-stage histograms; the SLO sentinel reports the injected
+    latency breach on stderr."""
+    requests = tmp_path / "requests.jsonl"
+    requests.write_text("\n".join([
+        json.dumps({"id": "r1", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "m", "type": "metrics"}),
+    ]) + "\n")
+    responses = tmp_path / "responses.jsonl"
+    assert main([
+        "serve", "--requests", str(requests),
+        "--responses", str(responses),
+        "--cache-dir", str(tmp_path / "store"),
+        "--metrics-port", "0",
+        "--slo-latency-p95-s", "1e-9", "--slo-interval-s", "60",
+    ]) == 0
+    err = capsys.readouterr().err
+    assert "serve: live metrics on http://" in err
+    # the injected (absurd) latency objective must trip the final
+    # sentinel evaluation
+    assert "slo latency_p95: BREACH" in err
+    lines = [json.loads(ln)
+             for ln in responses.read_text().splitlines()]
+    r1, m = lines
+    assert r1["ok"] and r1["trace_id"]
+    payload = m["metrics"]
+    assert payload["enabled"] is True
+    assert payload["histograms"]["request_total_s"]["count"] == 1
+    assert payload["slo"] is None or isinstance(payload["slo"], dict)
+    assert "pluss_request_total_s_bucket" in payload["prometheus"]
+    # serve tears the global registry down on exit
+    assert obs_metrics.get() is None
