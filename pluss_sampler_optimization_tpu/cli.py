@@ -400,6 +400,41 @@ def main(argv=None) -> int:
         "requests start the next batch (default: 64)",
     )
     ap.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        metavar="K",
+        help="service-routed runs (--cache-dir / serve mode): "
+        "partition the devices into K independent replica executors "
+        "(each with its own device group, mesh, and queue) and route "
+        "every execution to the least-loaded one, with work stealing "
+        "and failure quarantine. 0 = auto (one replica per device). "
+        "Pure scheduling: MRC bytes are bit-identical for any K. "
+        "Default: no pool (the single-device-set path). See README "
+        "\"Replica serving\".",
+    )
+    ap.add_argument(
+        "--warmup-from-ledger",
+        type=int,
+        default=None,
+        metavar="N",
+        help="serve mode, with --ledger: before processing requests, "
+        "pre-compile the sampled kernel signatures of the N most "
+        "frequent fingerprints in the ledger — the first real request "
+        "after a restart skips cold jit (its ledger row records "
+        "near-zero compile deltas)",
+    )
+    ap.add_argument(
+        "--compilation-cache-dir",
+        default=None,
+        metavar="DIR",
+        help="persist XLA-compiled executables under DIR (wires "
+        "jax_compilation_cache_dir with the min compile-time "
+        "threshold dropped to 0): a warm second process loads "
+        "executables instead of recompiling. Applies to every "
+        "engine-executing mode.",
+    )
+    ap.add_argument(
         "--metrics-port",
         type=int,
         default=None,
@@ -463,7 +498,24 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
+    if args.compilation_cache_dir:
+        # process-global: every engine-executing mode benefits, and
+        # service-routed sampled runs re-apply idempotently
+        from .config import SamplerConfig
+        from .sampler.sampled import _apply_compilation_cache
+
+        _apply_compilation_cache(
+            SamplerConfig(
+                compilation_cache_dir=args.compilation_cache_dir
+            )
+        )
+
     if args.mode != "serve":
+        if args.warmup_from_ledger is not None:
+            raise SystemExit(
+                "--warmup-from-ledger pre-compiles serving kernels at "
+                "startup; it applies to serve mode only"
+            )
         if args.metrics_port is not None:
             raise SystemExit(
                 "--metrics-port exposes the live serving registry; "
@@ -476,6 +528,15 @@ def main(argv=None) -> int:
                 "apply to serve mode only (offline ledgers are gated "
                 "by tools/check_slo.py)"
             )
+
+    if args.replicas is not None and args.replicas < 0:
+        raise SystemExit("--replicas must be >= 0 (0 = auto, one "
+                         "replica per device)")
+    if args.warmup_from_ledger is not None and not args.ledger:
+        raise SystemExit(
+            "--warmup-from-ledger reads kernel signatures from the "
+            "run ledger; it needs --ledger PATH"
+        )
 
     if args.mode == "serve":
         return _observed(args, lambda: _serve(args))
@@ -566,6 +627,11 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--batch-window-ms batches service-routed requests; it "
             "needs --cache-dir (or serve mode)"
+        )
+    if args.replicas is not None and not args.cache_dir:
+        raise SystemExit(
+            "--replicas partitions the service's devices into "
+            "replica executors; it needs --cache-dir (or serve mode)"
         )
 
     return _observed(
@@ -676,7 +742,15 @@ def _serve(args) -> int:
             ledger_path=args.ledger,
             batch_window_ms=args.batch_window_ms,
             batch_max_refs=args.batch_max_refs,
+            replicas=args.replicas,
         ) as svc:
+            if args.warmup_from_ledger:
+                warmed = svc.warm_from_ledger(args.warmup_from_ledger)
+                print(
+                    f"serve: warmed {warmed} kernel signature(s) "
+                    "from the ledger",
+                    file=sys.stderr,
+                )
             if (args.slo_latency_p95_s is not None
                     or args.slo_error_budget is not None):
                 from .config import SLOConfig
@@ -734,6 +808,7 @@ def _execute_via_service(args, machine, program, engine) -> int:
         cache_dir=args.cache_dir, ledger_path=args.ledger,
         batch_window_ms=args.batch_window_ms,
         batch_max_refs=args.batch_max_refs,
+        replicas=args.replicas,
     ) as svc:
         if args.mode == "speed":
             times = []

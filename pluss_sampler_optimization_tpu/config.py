@@ -117,6 +117,16 @@ class SamplerConfig:
     # vmap-safe sorted merge costs more than the dispatches it saves
     # (measured ~1.3x per element, gemm N=1024).
     fuse_refs: bool | None = None
+    # Persistent XLA compilation cache directory (satellite of the
+    # replica-pool PR): when set, the sampled entry points wire it into
+    # jax.config ("jax_compilation_cache_dir") with the minimum
+    # compile-time threshold dropped to 0 so even the CPU engines'
+    # fast-compiling kernels persist. A warm second PROCESS then loads
+    # executables instead of recompiling — its ledger rows record
+    # smaller compile-counter deltas (pinned by tests/test_replicas.py
+    # via subprocess). None = leave jax's global setting alone (the
+    # CLI's --compilation-cache-dir sets this and the global config).
+    compilation_cache_dir: str | None = None
     # Depth bound of the async dispatch pipeline: how many in-flight
     # dispatches (fused buckets, or host chunks on the legacy path)
     # may await their fetch before the oldest is drained. Each
@@ -166,6 +176,42 @@ class BatchConfig:
             raise ValueError("window_ms must be >= 0")
         if self.max_refs < 1:
             raise ValueError("max_refs must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaConfig:
+    """Device partitioning of the serving replica pool
+    (service/replicas.py::ReplicaPool).
+
+    The pool splits `jax.devices()` into `count` disjoint device
+    groups; each replica owns its group, a per-replica mesh
+    (parallel/mesh.py::build_mesh over just those devices), and an
+    execution slot. Like BatchConfig this is a pure scheduling knob:
+    engine placement moves WHERE a request runs, never what it
+    computes — the per-ref sample streams are seed-derived, so MRC
+    bytes are bit-identical for any replica count (the invariant
+    tests/test_replicas.py pins at counts 1/2/4) and `count` stays OUT
+    of the request fingerprint.
+
+    Attributes:
+      count: number of replicas. None or 0 = auto, one replica per
+        device. A count above the device count clamps down (a replica
+        needs at least one device).
+    """
+
+    count: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.count is not None and self.count < 0:
+            raise ValueError("replica count must be >= 0 (0 = auto)")
+
+    def resolve(self, n_devices: int) -> int:
+        """Actual replica count for a machine with n_devices."""
+        if n_devices < 1:
+            raise ValueError("need at least one device")
+        if not self.count:  # None or 0: one replica per device
+            return n_devices
+        return min(self.count, n_devices)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,89 @@
+"""Replica device placement: a thread-local device scope the engines
+consult when committing host buffers to a device.
+
+The replica pool (service/replicas.py) partitions `jax.devices()` into
+disjoint groups; each replica worker thread enters `device_scope(its
+devices)` around every engine execution. Inside the scope:
+
+- `place(x)` commits a host buffer to the replica's primary device
+  with an explicit `jax.device_put` (outside a scope it is plain
+  `jnp.asarray`, byte-for-byte the engines' historical behavior);
+- `jax.default_device` is set to the replica's primary device, so
+  arrays the engines create WITHOUT going through `place` (threefry
+  keys, iota scratch, ...) land on the same device and jit dispatch
+  follows them there;
+- `active_mesh()` exposes the replica's own 1-D sample mesh
+  (parallel/mesh.py::build_mesh over just its devices), which the
+  sharded entry points pick up when no explicit mesh is passed.
+
+Placement is pure routing: the per-ref sample streams are derived
+from seeds alone (numpy PCG on the host path, threefry counters on
+the device path), never from device identity, so results are
+bit-identical whichever replica — or how many replicas — served them
+(pinned by tests/test_replicas.py at replicas 1/2/4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+
+def active_devices():
+    """The device group of the enclosing `device_scope`, or None."""
+    return getattr(_tls, "devices", None)
+
+
+def active_device():
+    """Primary device of the enclosing scope, or None."""
+    devs = active_devices()
+    return devs[0] if devs else None
+
+
+def active_mesh():
+    """The enclosing scope's per-replica mesh, or None."""
+    return getattr(_tls, "mesh", None)
+
+
+def active_replica_id():
+    """Replica id of the enclosing scope, or None (set by the replica
+    pool's workers; fault-injection tests key on it)."""
+    return getattr(_tls, "replica_id", None)
+
+
+@contextlib.contextmanager
+def device_scope(devices, mesh=None, replica_id=None):
+    """Pin this thread's engine work to `devices` (a non-empty
+    sequence): explicit `place()` transfers target devices[0], and
+    jax.default_device covers every implicit array creation. Scopes
+    nest; the innermost wins."""
+    import jax
+
+    prev = (
+        getattr(_tls, "devices", None),
+        getattr(_tls, "mesh", None),
+        getattr(_tls, "replica_id", None),
+    )
+    _tls.devices = list(devices)
+    _tls.mesh = mesh
+    _tls.replica_id = replica_id
+    try:
+        with jax.default_device(_tls.devices[0]):
+            yield _tls.devices
+    finally:
+        _tls.devices, _tls.mesh, _tls.replica_id = prev
+
+
+def place(x):
+    """Commit one host buffer to the active scope's primary device
+    (explicit `jax.device_put`); outside any scope, plain
+    `jnp.asarray` — exactly the transfer the engines always did."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = active_device()
+    if dev is None:
+        return jnp.asarray(x)
+    return jax.device_put(x, dev)

@@ -74,6 +74,15 @@ from ..sampler.sampled import (
     pad_keys,
 )
 from .mesh import build_mesh
+from .placement import active_mesh
+
+
+def _default_mesh():
+    """Mesh for entry points called without one: the enclosing replica
+    scope's per-replica mesh when a replica pool routed the execution
+    here (parallel/placement.py), otherwise the full-device mesh —
+    the historical default."""
+    return active_mesh() or build_mesh()
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -382,7 +391,7 @@ def sampled_outputs_sharded(
     """Sharded sampled engine -> per-ref SampledRefResult (exact) plus
     the psum'd dense noshare histograms (per ref, for observability)."""
     cfg = cfg or SamplerConfig()
-    mesh = mesh or build_mesh()
+    mesh = mesh or _default_mesh()
     if batch is None:
         batch = default_batch()
     n_dev = mesh.devices.size
@@ -807,7 +816,7 @@ def run_periodic_sharded(
     computation per window (tests/test_parallel.py pins it on the
     8-device virtual mesh). Windows short of the mesh size are padded
     with repeats of the last window; padded outputs are dropped."""
-    mesh = mesh or build_mesh()
+    mesh = mesh or _default_mesh()
     from ..sampler.periodic import _compiled_nest_batch, run_periodic
 
     axis = mesh.axis_names[0]
@@ -869,7 +878,7 @@ def run_analytic_sharded(
     host-fold cutoff stay on the host lexsort (no device work exists
     to shard there); pass host_cutoff=0 to force the sharded engine
     path."""
-    mesh = mesh or build_mesh()
+    mesh = mesh or _default_mesh()
     from ..sampler.analytic import run_analytic
 
     return run_analytic(program, machine, batch=batch, seed=seed,
@@ -885,7 +894,7 @@ def run_exact_sharded(
     """The exact router (periodic -> analytic -> dense) with whichever
     engine it picks running mesh-sharded; `res.engine` records the
     choice, same contract as sampler/periodic.py::run_exact."""
-    mesh = mesh or build_mesh()
+    mesh = mesh or _default_mesh()
     from ..sampler.periodic import run_exact
 
     return run_exact(program, machine, max_share, mesh=mesh)
@@ -903,7 +912,7 @@ def run_dense_sharded(
     slice of the vmapped tid batch axis). Returns the same OracleResult
     as sampler/dense.py::run_dense.
     """
-    mesh = mesh or build_mesh()
+    mesh = mesh or _default_mesh()
     n_dev = mesh.devices.size
     if machine.thread_num % n_dev != 0:
         raise ValueError(

@@ -25,7 +25,10 @@ service/cache.py::validate_record):
   `mrc_digest`, and — for members of a cross-request batched
   execution — `batch_id`/`batch_members`, so joined executions stay
   auditable (the `stats` aggregate rolls them into batch occupancy
-  and batched-vs-solo latency);
+  and batched-vs-solo latency); service rows executed under a
+  replica pool also carry `replica_id` (which device group served
+  the execution — the aggregate's per-replica occupancy) and the
+  full `request` payload (what ledger-driven warm start replays);
 - kind "drift" (runtime/obs/drift.py): the sampled-vs-exact MRC error
   metrics (`max_abs_delta` / `mean_abs_delta`) and the `breach` flag;
 - kind "bench" (bench.py): the headline `metric`/`value` plus the same
@@ -164,6 +167,13 @@ def validate_row(row) -> list[str]:
                 need_num(stage, nullable=True)
         if "coalesced" in row:
             need_num("coalesced", nullable=True)
+        # replica-pool context: which device group served the
+        # execution, and the replayable request payload warm start
+        # reads — optional, solo/poolless rows simply omit them
+        if "replica_id" in row:
+            need_num("replica_id", nullable=True)
+        if "request" in row and not isinstance(row["request"], dict):
+            errors.append("'request' must be an object")
     elif kind == "drift":
         need_str("model")
         need_num("n")
@@ -267,6 +277,11 @@ def aggregate(rows: list[dict]) -> dict:
     # `coalesced` count for singleflight joiners
     service = {"submitted": 0, "coalesced": 0, "completed": 0,
                "failed": 0, "degraded": 0}
+    # per-replica occupancy at execution grain: one request row per
+    # served execution, grouped by the replica that ran it — the
+    # ledger face of the executor's `replicas` snapshot and the
+    # requests_routed_r* counters
+    replicas: dict = {}
     for row in rows:
         kind = row["kind"]
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -278,6 +293,16 @@ def aggregate(rows: list[dict]) -> dict:
                 service["completed" if row["ok"] else "failed"] += 1
                 if row.get("degraded"):
                     service["degraded"] += 1
+            rid = row.get("replica_id")
+            if rid is not None:
+                r = replicas.setdefault(
+                    int(rid), {"rows": 0, "ok": 0, "degraded": 0}
+                )
+                r["rows"] += 1
+                if row["ok"]:
+                    r["ok"] += 1
+                if row.get("degraded"):
+                    r["degraded"] += 1
             bid = row.get("batch_id")
             if bid is not None:
                 b = batches.setdefault(bid, {"rows": 0, "members": 0})
@@ -348,6 +373,7 @@ def aggregate(rows: list[dict]) -> dict:
         "bench_rows": bench,
         "batching": batching,
         "service": service,
+        "replicas": replicas,
     }
 
 
@@ -398,6 +424,19 @@ def format_stats(agg: dict) -> list[str]:
                 b["occupancy_p50"], b["occupancy_p95"],
                 b["batched_p50_latency_s"], b["solo_p50_latency_s"],
             )
+        )
+    reps = agg.get("replicas")
+    if reps:
+        parts = ", ".join(
+            "r%d=%d%s" % (
+                rid, r["rows"],
+                (" (degraded %d)" % r["degraded"])
+                if r["degraded"] else "",
+            )
+            for rid, r in sorted(reps.items())
+        )
+        lines.append(
+            "replicas: %d active, executions %s" % (len(reps), parts)
         )
     svc = agg.get("service")
     if svc and svc["submitted"]:

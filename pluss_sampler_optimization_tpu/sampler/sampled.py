@@ -72,6 +72,40 @@ def default_batch() -> int:
 DEFAULT_CAPACITY = 64
 
 
+def _place(x):
+    """Commit one host buffer to the active replica's device
+    (parallel/placement.py): an explicit jax.device_put inside a
+    replica device scope, plain jnp.asarray outside one. Imported at
+    call time — parallel/__init__ pulls in sharded.py, which imports
+    THIS module."""
+    from ..parallel.placement import place
+
+    return place(x)
+
+
+# directories already wired into jax's persistent compilation cache
+# (the config update is process-global; re-applying it per run would
+# just churn the config lock)
+_CACHE_DIRS_APPLIED: set = set()
+
+
+def _apply_compilation_cache(cfg) -> None:
+    """Wire SamplerConfig.compilation_cache_dir into jax's persistent
+    compilation cache, dropping the min compile-time threshold to 0 so
+    even the CPU engines' fast-compiling kernels persist. A warm
+    second process then loads executables instead of recompiling (its
+    ledger rows record smaller compile deltas). No-op when the config
+    carries no directory."""
+    d = getattr(cfg, "compilation_cache_dir", None)
+    if not d or d in _CACHE_DIRS_APPLIED:
+        return
+    _CACHE_DIRS_APPLIED.add(d)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", 0.0
+    )
+
+
 @dataclasses.dataclass
 class SampledRefResult:
     """Exact per-tracked-ref sampled histograms (host form)."""
@@ -817,7 +851,7 @@ def per_sample_ri(
     """
     trace = ProgramTrace(program, machine)
     nt = trace.nests[nest_idx]
-    samples = jnp.asarray(np.asarray(samples, dtype=np.int64))
+    samples = _place(np.asarray(samples, dtype=np.int64))
     tid, p0, line, m0 = _sample_geometry(nt, ref_idx, samples)
     best, best_sink = _best_sink(nt, ref_idx, tid, p0, line, m0)
     found = best < INF
@@ -936,6 +970,7 @@ def warmup(
     capacity-regrow recompile (drain loop in sampled_outputs) lands in
     the subsequent run, a deliberately conservative accounting."""
     cfg = cfg or SamplerConfig()
+    _apply_compilation_cache(cfg)
     if batch is None:
         batch = default_batch()
     with telemetry.span("warmup", engine="sampled"):
@@ -974,7 +1009,7 @@ def _warmup_kernels(program, machine, cfg, batch, capacity) -> None:
                         jax.random.key(0), jnp.int64(space_box),
                         jnp.int64(s_plan),
                     ))
-                dummy = jnp.zeros(B, dtype=jnp.int64)
+                dummy = _place(jnp.zeros(B, dtype=jnp.int64))
                 jax.block_until_ready(kernel_s(
                     dummy, dummy < 0, _pad_highs(highs), nt.vals,
                     np.int64(ri), capacity, B // batch,
@@ -985,9 +1020,13 @@ def _warmup_kernels(program, machine, cfg, batch, capacity) -> None:
         chunk, n_valid = pad_keys(
             keys, 1, total=batch if s > batch else None
         )
+        # _place, like the run's chunk commit: inside a replica scope
+        # a committed input is part of the jit cache key, so an
+        # unplaced warmup would compile a signature the routed run
+        # cannot reuse
         jax.block_until_ready(
             kernel(
-                jnp.asarray(chunk), n_valid, _pad_highs(highs), nt.vals,
+                _place(chunk), n_valid, _pad_highs(highs), nt.vals,
                 np.int64(ri), capacity,
             )
         )
@@ -1038,7 +1077,7 @@ def _warmup_fused(trace, rows, cfg, batch, capacity) -> None:
                         jnp.stack([jax.random.key(i) for i in range(R)]),
                         jnp.int64(space_box), jnp.int64(s_plan),
                     ))
-                dummy = jnp.zeros((R, B), dtype=jnp.int64)
+                dummy = _place(jnp.zeros((R, B), dtype=jnp.int64))
                 jax.block_until_ready(fused(
                     dummy, dummy < 0, ph, nt.vals, rx_R, capacity,
                     B // batch,
@@ -1046,9 +1085,11 @@ def _warmup_fused(trace, rows, cfg, batch, capacity) -> None:
                 continue
             # over-budget buckets take the host path below
         g, _ = _host_fuse_plan(s, batch)
-        dummy = jnp.zeros((R, g * batch), dtype=jnp.int64)
+        # _place matches the run's make_inputs commit (replica scope)
+        dummy = _place(jnp.zeros((R, g * batch), dtype=jnp.int64))
+        msk = _place(jnp.zeros((R, g * batch), dtype=bool))
         jax.block_until_ready(fused(
-            dummy, dummy < 0, ph, nt.vals, rx_R, capacity, g
+            dummy, msk, ph, nt.vals, rx_R, capacity, g
         ))
 
 
@@ -1289,7 +1330,7 @@ def _sampled_outputs_serial(
                     keys_all[s0 : s0 + batch], 1,
                     total=batch if n_samples > batch else None,
                 )
-                chunk = jnp.asarray(chunk)
+                chunk = _place(chunk)
 
                 def redo(c2, chunk=chunk, n_valid=n_valid, ph=ph,
                          nv=nt.vals, rxv=rxv):
@@ -1545,7 +1586,7 @@ def _sampled_outputs_fused(
                         buf[j, :len(seg)] = seg
                         buf[j, len(seg):] = ka[0]  # decodable padding
                         msk[j, :len(seg)] = True
-                    return jnp.asarray(buf), jnp.asarray(msk)
+                    return _place(buf), _place(msk)
 
                 dispatch_group(
                     fused, mem, make_inputs, ph, nt.vals, rx_R, g
@@ -1648,6 +1689,7 @@ def run_sampled(
 ) -> tuple[PRIState, list[SampledRefResult]]:
     """Sampled engine -> PRIState (see fold_results for the v1 form)."""
     cfg = cfg or SamplerConfig()
+    _apply_compilation_cache(cfg)
     with telemetry.span("engine", engine="sampled"):
         results = sampled_outputs(program, machine, cfg, **kw)
         with telemetry.span("merge", stage="fold_results"):
@@ -1889,7 +1931,7 @@ def sampled_outputs_multi(
                         buf[row, :len(seg)] = seg
                         buf[row, len(seg):] = m["keys"][0]
                         msk[row, :len(seg)] = True
-                    return jnp.asarray(buf), jnp.asarray(msk)
+                    return _place(buf), _place(msk)
 
                 dispatch_group(fused, mem, make_inputs, ph_R, nv_R,
                                rx_R, g)
@@ -1926,6 +1968,8 @@ def run_sampled_multi(
         (p, m, c if c is not None else SamplerConfig(), bool(v2))
         for p, m, c, v2 in jobs
     ]
+    for _p, _m, c, _v2 in norm:
+        _apply_compilation_cache(c)
     with telemetry.span("engine", engine="sampled",
                         batch_members=len(norm)):
         outs = sampled_outputs_multi(

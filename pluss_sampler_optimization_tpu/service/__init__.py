@@ -4,9 +4,11 @@ Turns the engines into an on-demand system: content-addressed result
 caching (two-tier, versioned, corruption-tolerant — service/cache.py),
 canonical request fingerprints (service/fingerprint.py), singleflight
 request execution with deadlines and engine degradation
-(service/executor.py), and the submit/result + JSONL serving API
-(service/api.py). CLI entry points: `serve` mode and `--cache-dir`
-(cli.py); store audits: tools/check_service_store.py.
+(service/executor.py), replica-pool device partitioning with
+load-aware routing, work stealing, and failure quarantine
+(service/replicas.py), and the submit/result + JSONL serving API
+(service/api.py). CLI entry points: `serve` mode, `--cache-dir`, and
+`--replicas` (cli.py); store audits: tools/check_service_store.py.
 """
 
 from .api import (
@@ -32,6 +34,7 @@ from .fingerprint import (
     request_fingerprint,
     structure_digest,
 )
+from .replicas import Replica, ReplicaPool, current_replica_id
 
 __all__ = [
     "AnalysisRequest",
@@ -53,4 +56,7 @@ __all__ = [
     "content_digest",
     "request_fingerprint",
     "structure_digest",
+    "Replica",
+    "ReplicaPool",
+    "current_replica_id",
 ]

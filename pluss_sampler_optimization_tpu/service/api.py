@@ -175,6 +175,10 @@ class AnalysisResponse:
     # hits with no execution.
     trace_id: str | None = None
     span_id: str | None = None
+    # the replica whose device group executed the request (None:
+    # cache hit, no pool, or failure before execution). Serving
+    # metadata only — MRC bytes are identical whichever replica ran
+    replica_id: int | None = None
 
     def to_jsonl_dict(self) -> dict:
         """The wire form `serve` emits: compact — the MRC ships in the
@@ -198,6 +202,8 @@ class AnalysisResponse:
             d["trace_id"] = self.trace_id
         if self.span_id is not None:
             d["span_id"] = self.span_id
+        if self.replica_id is not None:
+            d["replica_id"] = self.replica_id
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
@@ -226,6 +232,7 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             error=outcome.get("error") or "execution failed",
             trace_id=outcome.get("trace_id"),
             span_id=outcome.get("span_id"),
+            replica_id=outcome.get("replica_id"),
         )
     return AnalysisResponse(
         id=request.id,
@@ -246,6 +253,7 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         error=None,
         trace_id=outcome.get("trace_id"),
         span_id=outcome.get("span_id"),
+        replica_id=outcome.get("replica_id"),
     )
 
 
@@ -258,7 +266,8 @@ class AnalysisService:
                  runner=default_runner,
                  ledger_path: str | None = None,
                  batch_window_ms: float | None = None,
-                 batch_max_refs: int = 64):
+                 batch_max_refs: int = 64,
+                 replicas=None):
         from ..config import BatchConfig
 
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
@@ -275,7 +284,57 @@ class AnalysisService:
                             max_refs=batch_max_refs)
                 if batch_window_ms is not None else None
             ),
+            # int | ReplicaConfig | None (None = no pool, the PR 9
+            # single-device-set behavior)
+            replicas=replicas,
         )
+
+    def warm_from_ledger(self, top_n: int) -> int:
+        """Ledger-driven warm start: pre-compile the sampled kernel
+        signatures of the `top_n` most frequent fingerprints in the
+        ledger tail, so the first real request after a restart skips
+        cold jit (its ledger row then records near-zero compile
+        deltas — the property tests/test_replicas.py pins). Rows
+        written before the ledger carried request payloads, and
+        non-sampled rows (their engines have no warmup entry point),
+        are skipped. Returns the number of warmup executions run."""
+        import collections as _collections
+
+        from ..runtime.obs import ledger as obs_ledger
+        from .executor import sampler_config
+
+        if not self.ledger_path or top_n <= 0:
+            return 0
+        try:
+            rows = obs_ledger.read_rows(self.ledger_path)
+        except Exception:
+            return 0
+        by_fp: dict = {}
+        freq: _collections.Counter = _collections.Counter()
+        for row in rows:
+            if row.get("kind") != "request":
+                continue
+            payload = row.get("request")
+            if not isinstance(payload, dict):
+                continue
+            if payload.get("engine") != "sampled":
+                continue
+            fp = row.get("fingerprint")
+            if not fp:
+                continue
+            freq[fp] += 1 + int(row.get("coalesced") or 0)
+            by_fp[fp] = payload
+        jobs = []
+        for fp, _ in freq.most_common(top_n):
+            try:
+                req = AnalysisRequest(**by_fp[fp])
+                jobs.append((
+                    req.build_program(), req.machine(),
+                    sampler_config(req),
+                ))
+            except Exception:
+                continue
+        return self.executor.warm_structures(jobs)
 
     def healthz(self) -> dict:
         """Liveness + capability roster (the `healthz` request type).
@@ -284,6 +343,7 @@ class AnalysisService:
         from .cache import STORE_VERSION
 
         ex = self.executor.stats()
+        reps = ex.get("replicas") or {}
         return {
             "status": "ok",
             "engines": list(SERVICE_ENGINES),
@@ -291,6 +351,8 @@ class AnalysisService:
             "in_flight": ex["in_flight"],
             "queue_depth": ex["queue_depth"],
             "batch_queue_depth": ex["batch_queue_depth"],
+            "replicas": reps.get("count", 0),
+            "replicas_quarantined": reps.get("quarantined", 0),
             "ledger": self.ledger_path,
         }
 

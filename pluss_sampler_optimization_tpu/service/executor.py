@@ -29,6 +29,17 @@ query":
   exactly ONE.
 - **Bounded concurrency.** A ThreadPoolExecutor caps concurrent
   pipelines; `service_queue_depth` gauges the in-flight count.
+- **Replica routing.** With a replica pool configured
+  (service/replicas.py), every engine execution — a solo chain
+  attempt or a whole flushed batch window — runs inside ONE replica's
+  device scope: least-loaded routing, work stealing between idle
+  replicas, and failure quarantine. A quarantine re-route lands in
+  the request's degradation chain (`{"from": "replica:K", ...}`), so
+  the completion is counted `service_degraded` and the SLO sentinel's
+  error budget sees it; like other degraded results it is never
+  persisted to the cache. max_workers is clamped UP to the replica
+  count — fewer pool threads than replicas would strand replicas
+  idle with work queued behind busy ones.
 
 The engine table and the runner hook are module-level / constructor
 injection points so tests can wrap them (e.g. add a barrier to force
@@ -45,13 +56,16 @@ import time
 import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 
-from ..config import BatchConfig, MachineConfig, SamplerConfig
+from ..config import (
+    BatchConfig, MachineConfig, ReplicaConfig, SamplerConfig,
+)
 from ..ir import Program
 from ..runtime import report, telemetry
 from ..runtime.aet import aet_mrc
 from ..runtime.cri import cri_distribute
 from ..runtime.obs import ledger as obs_ledger
 from .cache import STORE_VERSION, ResultCache
+from .replicas import ReplicaPool
 
 # Fallback order per requested engine: the exact family degrades
 # toward the sampled engine (cheap, approximate, always applicable).
@@ -381,12 +395,34 @@ class RequestExecutor:
                  max_workers: int = 4, runner=default_runner,
                  ledger_path: str | None = None,
                  batching: BatchConfig | None = None,
-                 batch_runner=default_batch_runner):
+                 batch_runner=default_batch_runner,
+                 replicas: ReplicaConfig | int | None = None):
         self.cache = cache if cache is not None else ResultCache()
         self.runner = runner
         self.batch_runner = batch_runner
-        self.max_workers = max_workers
         self.ledger_path = ledger_path
+        self._replicas: ReplicaPool | None = None
+        if replicas is not None:
+            cfg = (
+                replicas if isinstance(replicas, ReplicaConfig)
+                else ReplicaConfig(count=replicas)
+            )
+            self._replicas = ReplicaPool(cfg)
+            n = len(self._replicas)
+            if max_workers < n:
+                # fewer pool threads than replicas silently strands
+                # replicas: a replica only receives work a pool thread
+                # submits, so an unreachable replica sits idle while
+                # work queues behind the few reachable ones
+                telemetry.warn_once(
+                    f"max_workers_clamped:{max_workers}:{n}",
+                    f"--max-workers {max_workers} < {n} replicas "
+                    f"would strand replicas idle; clamped to {n}",
+                    requested=max_workers, replicas=n,
+                )
+                telemetry.count("max_workers_clamped")
+                max_workers = n
+        self.max_workers = max_workers
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers,
             thread_name_prefix="pluss-service",
@@ -462,6 +498,11 @@ class RequestExecutor:
             out["solo_p50_latency_s"] = round(
                 obs_ledger._percentile(lat_s, 0.50), 6
             )
+        if self._replicas is not None:
+            # per-replica occupancy — the instance-local face of the
+            # same counts /metrics exports (requests_routed_r*) and
+            # check_ledger --stats aggregates (rows' replica_id)
+            out["replicas"] = self._replicas.snapshot()
         return out
 
     def _note_latency(self, outcome: dict, batched: bool) -> None:
@@ -590,6 +631,54 @@ class RequestExecutor:
             # pool stops accepting work
             self._batcher.close()
         self._pool.shutdown(wait=True)
+        if self._replicas is not None:
+            # last: every pool worker has returned, so no execution
+            # is still waiting on a replica future
+            self._replicas.close()
+
+    # -- replica routing ----------------------------------------------
+
+    def _execute_routed(self, fn, trace_id=None, members: int = 1):
+        """Run one engine execution (a solo chain attempt or a whole
+        batch window) on the replica pool when one exists, inline
+        otherwise. Returns (fn's result, replica_id|None, re-route
+        degradation events)."""
+        if self._replicas is None:
+            return fn(), None, []
+        return self._replicas.run(
+            fn, trace_id=trace_id, members=members
+        )
+
+    def _absorb_replica_events(self, degraded: list, events,
+                               fingerprint: str) -> None:
+        """Fold the pool's quarantine re-route events into a request's
+        degradation chain, mirroring engine downgrades: each lands in
+        the response/ledger `degraded` list AND as a
+        `service_degraded` telemetry event (the completion is then
+        counted degraded, which is what the SLO error budget reads)."""
+        for info in events:
+            degraded.append(dict(info))
+            telemetry.event(
+                "service_degraded", fingerprint=fingerprint, **info
+            )
+
+    def warm_structures(self, jobs) -> int:
+        """Pre-compile sampled kernel signatures: `jobs` is
+        [(program, machine, SamplerConfig|None)]. With a pool, every
+        replica compiles on ITS devices (structure-keyed, so repeats
+        are free); without one, a single inline warmup. Returns the
+        number of warmup executions performed. Used by ledger-driven
+        warm start (`--warmup-from-ledger`)."""
+        done = 0
+        for program, machine, cfg in jobs:
+            if self._replicas is not None:
+                done += self._replicas.warmup(program, machine, cfg)
+            else:
+                from ..sampler.sampled import warmup
+
+                warmup(program, machine, cfg)
+                done += 1
+        return done
 
     # -- worker -------------------------------------------------------
 
@@ -617,12 +706,15 @@ class RequestExecutor:
                 fetch_s = time.perf_counter() - fetch_t0
                 degraded: list[dict] = []
                 error = None
+                replica_id = None
                 if record is None:
                     span_id = uuid.uuid4().hex[:16]
                     exec_t0 = time.perf_counter()
-                    record, degraded, error = self._run_chain(
-                        request, program, machine, fingerprint,
-                        trace_id=trace_id, span_id=span_id,
+                    record, degraded, error, replica_id = (
+                        self._run_chain(
+                            request, program, machine, fingerprint,
+                            trace_id=trace_id, span_id=span_id,
+                        )
                     )
                     execute_s = time.perf_counter() - exec_t0
                     if record is not None and not degraded:
@@ -646,6 +738,7 @@ class RequestExecutor:
             "span_id": span_id,
             "queue_s": queue_s,
             "execute_s": execute_s,
+            "replica_id": replica_id,
         }
         self._observe_stages(outcome, queue_s=queue_s,
                              execute_s=execute_s, fetch_s=fetch_s)
@@ -761,14 +854,25 @@ class RequestExecutor:
         telemetry.gauge("batch_occupancy", len(runnable))
         self._count("active")
         telemetry.count("service_exec_started")
-        try:
-            exec_t0 = time.perf_counter()
+
+        def _run_window():
+            # the span opens on the EXECUTING thread (a replica worker
+            # when a pool routes the window), so its attrs carry the
+            # replica's device scope implicitly
             with telemetry.span("service_exec", engine="sampled",
                                 batch=len(runnable), batch_id=batch_id,
                                 span_id=span_id):
-                outs = self.batch_runner([
+                return self.batch_runner([
                     (e.request, e.program, e.machine) for e in runnable
                 ])
+
+        try:
+            exec_t0 = time.perf_counter()
+            outs, batch_rid, batch_events = self._execute_routed(
+                _run_window,
+                trace_id=getattr(runnable[0].request, "trace_id", None),
+                members=len(runnable),
+            )
             execute_s = time.perf_counter() - exec_t0
             telemetry.count("service_exec_done")
         except Exception:
@@ -789,17 +893,26 @@ class RequestExecutor:
                 )
                 # per-member cache write: EVERY member lands in the
                 # store under its own fingerprint, so a warm repeat of
-                # any of them is a hit with zero executions
-                self.cache.put(e.fingerprint, record)
+                # any of them is a hit with zero executions — except
+                # after a quarantine re-route, which (like any other
+                # degradation) is served but never persisted
+                if not batch_events:
+                    self.cache.put(e.fingerprint, record)
                 fetch_s = time.perf_counter() - fetch_t0
             except Exception:
                 self._solo_fallback(e, compiles0)
                 continue
             self._count("completed")
+            degraded: list[dict] = []
+            self._absorb_replica_events(
+                degraded, batch_events, e.fingerprint
+            )
+            if degraded:
+                self._count("degraded")
             outcome = {
                 "record": record,
                 "cache": "miss",
-                "degraded": [],
+                "degraded": degraded,
                 "error": None,
                 # from enqueue: the member's latency honestly includes
                 # its admission-window wait — the trade-off the
@@ -814,6 +927,9 @@ class RequestExecutor:
                 "batch_wait_s": self._batch_wait_s(e),
                 "queue_s": self._queue_wait_s(e, exec_start),
                 "execute_s": execute_s,
+                # the replica that ultimately served the window (the
+                # re-route target when quarantine moved it)
+                "replica_id": batch_rid,
             }
             self._observe_stages(
                 outcome, queue_s=outcome["queue_s"],
@@ -845,14 +961,14 @@ class RequestExecutor:
         span_id = uuid.uuid4().hex[:16]
         exec_t0 = time.perf_counter()
         try:
-            record, degraded, error = self._run_chain(
+            record, degraded, error, replica_id = self._run_chain(
                 e.request, e.program, e.machine, e.fingerprint,
                 trace_id=trace_id, span_id=span_id,
             )
             if record is not None and not degraded:
                 self.cache.put(e.fingerprint, record)
         except Exception as exc:
-            record, degraded, error = None, [], repr(exc)
+            record, degraded, error, replica_id = None, [], repr(exc), None
         execute_s = time.perf_counter() - exec_t0
         self._count("completed" if record is not None else "failed")
         if degraded:
@@ -871,6 +987,7 @@ class RequestExecutor:
             "span_id": span_id,
             "batch_wait_s": self._batch_wait_s(e),
             "execute_s": execute_s,
+            "replica_id": replica_id,
         }
         self._observe_stages(
             outcome, batch_wait_s=outcome["batch_wait_s"],
@@ -966,6 +1083,16 @@ class RequestExecutor:
         # (possibly shared) execution span on span_id
         row["trace_id"] = outcome.get("trace_id")
         row["span_id"] = outcome.get("span_id")
+        if outcome.get("replica_id") is not None:
+            row["replica_id"] = outcome["replica_id"]
+        # the full request payload makes the ledger replayable: warm
+        # start (--warmup-from-ledger) rebuilds the row's program/
+        # machine/sampler config from it to pre-compile the kernels a
+        # restarted serve process is about to need
+        try:
+            row["request"] = request.payload()
+        except Exception:
+            pass
         for stage in ("queue_s", "batch_wait_s", "execute_s"):
             v = outcome.get(stage)
             if v is not None:
@@ -988,7 +1115,9 @@ class RequestExecutor:
                    trace_id: str | None = None,
                    span_id: str | None = None):
         """Walk the degradation chain under the request deadline.
-        Returns (record|None, degraded events, error|None)."""
+        Returns (record|None, degraded events, error|None,
+        replica_id|None — the replica that served the successful
+        attempt)."""
         chain = degrade_chain(request.engine)
         deadline = (
             None if request.deadline_s is None
@@ -1013,22 +1142,29 @@ class RequestExecutor:
             try:
                 if remaining is None or is_last:
                     # no budget to enforce (or nothing to fall back
-                    # to): run inline on this worker
-                    return (
-                        execute_request(
-                            request, program, machine, engine,
+                    # to): run on this worker (or its routed replica)
+                    record, rid, events = self._execute_routed(
+                        lambda eng=engine: execute_request(
+                            request, program, machine, eng,
                             fingerprint, self.runner,
                             trace_id=trace_id, span_id=span_id,
                         ),
-                        degraded,
-                        None,
+                        trace_id=trace_id,
                     )
-                record = self._attempt_with_timeout(
+                    self._absorb_replica_events(
+                        degraded, events, fingerprint
+                    )
+                    return record, degraded, None, rid
+                hit = self._attempt_with_timeout(
                     request, program, machine, engine, fingerprint,
                     remaining, trace_id=trace_id, span_id=span_id,
                 )
-                if record is not None:
-                    return record, degraded, None
+                if hit is not None:
+                    record, rid, events = hit
+                    self._absorb_replica_events(
+                        degraded, events, fingerprint
+                    )
+                    return record, degraded, None, rid
                 self._note_degrade(
                     degraded, fingerprint, engine, chain[i + 1],
                     f"deadline {request.deadline_s}s overrun",
@@ -1037,26 +1173,31 @@ class RequestExecutor:
                 last_error = repr(e)
                 telemetry.count("service_exec_failed")
                 if is_last:
-                    return None, degraded, last_error
+                    return None, degraded, last_error, None
                 self._note_degrade(
                     degraded, fingerprint, engine, chain[i + 1],
                     f"engine failed: {last_error[:200]}",
                 )
-        return None, degraded, last_error or "no engine attempted"
+        return None, degraded, last_error or "no engine attempted", None
 
     def _attempt_with_timeout(self, request, program, machine, engine,
                               fingerprint, budget_s: float,
                               trace_id=None, span_id=None):
         """Run one attempt in a side thread and wait at most budget_s.
         None = overrun (the attempt thread is abandoned; Python offers
-        no preemption, so its work completes unobserved)."""
+        no preemption, so its work completes unobserved). On success
+        returns (record, replica_id|None, re-route events)."""
         box: dict = {}
 
         def target():
             try:
-                box["record"] = execute_request(
-                    request, program, machine, engine, fingerprint,
-                    self.runner, trace_id=trace_id, span_id=span_id,
+                box["result"] = self._execute_routed(
+                    lambda: execute_request(
+                        request, program, machine, engine,
+                        fingerprint, self.runner,
+                        trace_id=trace_id, span_id=span_id,
+                    ),
+                    trace_id=trace_id,
                 )
             except Exception as e:
                 box["error"] = e
@@ -1072,7 +1213,7 @@ class RequestExecutor:
             return None
         if "error" in box:
             raise box["error"]
-        return box["record"]
+        return box["result"]
 
     def _note_degrade(self, degraded, fingerprint, from_engine,
                       to_engine, reason: str) -> None:

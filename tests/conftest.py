@@ -25,14 +25,17 @@ force_virtual_cpu(8)
 import jax  # noqa: E402
 
 # Persistent XLA compile cache: the suite's wall time is dominated by
-# jit compiles (sharded sampled kernels especially); the cache is
-# content-keyed so repeat runs skip them.
+# jit compiles (sharded sampled kernels especially; the replica tests
+# add per-leader-device variants); the cache is content-keyed so
+# repeat runs skip them.  The low persistence threshold matters: CPU
+# kernel compiles here are mostly 0.1-1 s each but number in the
+# hundreds, and the suite must fit the tier-1 870 s budget.
 try:
     jax.config.update(
         "jax_compilation_cache_dir",
         os.path.join(os.path.dirname(__file__), "..", ".jax_cache", "tests"),
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
 except Exception:
     pass
 
