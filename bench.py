@@ -1367,6 +1367,43 @@ def main() -> int:
         except Exception as e:  # never sink the headline metric
             so["error"] = repr(e)
 
+    # Static-analyzer (analysis/) wall time per registry model: the
+    # preflight gate runs on EVERY service submission, so its cost is
+    # a standing serving claim — the evidence records per-model
+    # analyzer wall (validation + dependence tests + bounds) and the
+    # verdict, at the bench model's size for the bench model and a
+    # small reference size for the rest of the registry.
+    if extras_budget_left("ir_preflight", extra):
+        ip: dict = {}
+        extra["ir_preflight"] = ip
+        try:
+            from pluss_sampler_optimization_tpu import analysis
+            from pluss_sampler_optimization_tpu.models import (
+                REGISTRY,
+            )
+            from pluss_sampler_optimization_tpu.models import (
+                build as build_model,
+            )
+
+            per_model: dict = {}
+            for name in sorted(REGISTRY):
+                bn = args.n if name == args.model else 24
+                rep = analysis.analyze_program(
+                    build_model(name, bn), machine
+                )
+                per_model[name] = {
+                    "n": bn,
+                    "verdict": rep.verdict,
+                    "races": len(rep.races),
+                    "wall_ms": round(rep.wall_s * 1e3, 3),
+                }
+            ip["models"] = per_model
+            ip["total_wall_ms"] = round(
+                sum(m["wall_ms"] for m in per_model.values()), 3
+            )
+        except Exception as e:  # never sink the headline metric
+            ip["error"] = repr(e)
+
     if have_counters and "compile_cache" in extra:
         # final snapshot: the extras (periodic_exact, second model) may
         # have compiled too; "total" must mean the whole process

@@ -172,7 +172,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
     ap.add_argument("mode", nargs="?",
                     choices=["acc", "speed", "sample", "trace",
-                             "serve", "stats"])
+                             "serve", "stats", "analyze"])
     ap.add_argument("--list-models", action="store_true",
                     help="print the model registry (nest/ref geometry "
                     "+ exact-router analytic audit status, from "
@@ -258,6 +258,11 @@ def main(argv=None) -> int:
                     "here and resume an interrupted run")
     ap.add_argument("--mrc-out", default=None,
                     help="also write the MRC to this file")
+    ap.add_argument("--analysis-json", action="store_true",
+                    help="analyze mode: emit the full machine-"
+                    "readable analysis report (diagnostics, "
+                    "classified dependences, bounds) as JSON instead "
+                    "of the summary table")
     ap.add_argument("--diff-against", default=None, metavar="ENGINE",
                     help="run a second engine and fail unless its dumps "
                     "are byte-identical (automates the reference's "
@@ -488,10 +493,14 @@ def main(argv=None) -> int:
         return _list_models()
     if args.mode is None:
         ap.error("mode is required (acc|speed|sample|trace|serve|"
-                 "stats)")
+                 "stats|analyze)")
 
     if args.mode == "stats":
         return _stats(args)
+    if args.mode == "analyze":
+        # jax-free early dispatch like stats: the analysis passes are
+        # pure numpy + stdlib
+        return _analyze(args)
 
     if args.platform:
         import jax
@@ -672,6 +681,50 @@ def _observed(args, fn) -> int:
                     exporters.write_chrome_trace(args.trace_out, doc)
                 if args.metrics_out:
                     exporters.write_prometheus(args.metrics_out, doc)
+
+
+def _analyze(args) -> int:
+    """`analyze` mode: the static preflight passes (analysis/) for one
+    model — well-formedness diagnostics, dependence/race verdict, and
+    the locality bounds — with no jax import and no engine run.
+    `--analysis-json` emits the full machine-readable report instead
+    of the table. Exit 0 when the IR is simulable (verdict ok or
+    race — a race is a property of the modeled OpenMP program, not an
+    input error), 1 when invalid."""
+    import json as _json
+
+    from . import analysis
+    from .config import MachineConfig
+
+    machine = MachineConfig(
+        thread_num=args.threads, chunk_size=args.chunk
+    )
+    program = _build_model(args.model, args.n, args.tsteps)
+    report = analysis.analyze_program(program, machine)
+    if args.analysis_json:
+        print(_json.dumps(report.to_dict(), indent=2, sort_keys=True))
+        return 0 if report.ok else 1
+    print(f"{program.name}: verdict {report.verdict} "
+          f"({report.wall_s * 1e3:.1f} ms)")
+    for d in report.diagnostics:
+        print(f"  [{d.severity}] {d.code} at {d.path}: {d.message}")
+    if report.bounds is not None:
+        b = report.bounds
+        print(f"  accesses {b.total_accesses}, compulsory-miss lower "
+              f"bound {b.compulsory_lower} lines, "
+              + (f"cold footprint {b.cold_model} lines (exact), "
+                 f"MRC asymptote {b.asymptote:.6g}"
+                 if b.exact else
+                 "footprint bounded by interval analysis "
+                 "(domain too large for exact enumeration)"))
+        carried = sum(
+            1 for dep in report.dependences
+            if dep.kind == analysis.DEP_CARRIED
+        )
+        print(f"  dependences: {len(report.dependences)} classified "
+              f"pairs, {carried} carried, {len(report.races)} "
+              "race-flagged")
+    return 0 if report.ok else 1
 
 
 def _stats(args) -> int:

@@ -118,6 +118,13 @@ class Ref:
       share_ratio: number of *other* simulated threads racing on the line
         (THREAD_NUM-1 at the update site, ...ri-omp-seq.cpp:204); None
         defaults to machine.thread_num - 1 at runtime.
+      write: whether this reference is a store. The engines never read
+        it (locality is direction-blind), but the static race detector
+        (analysis/deps.py) needs it. None means "derive": under the
+        generated-sampler convention every store is a read-modify-write
+        *pair* of refs sharing one affine map, so a duplicated map marks
+        a write — set False on repeated reads of one element (heat-3d's
+        stencil center, gesummv's x) where that convention misreads.
     """
 
     name: str
@@ -128,6 +135,7 @@ class Ref:
     slot: str = "pre"
     share_threshold: Optional[int] = None
     share_ratio: Optional[int] = None
+    write: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.level < 0 or self.level >= MAX_DEPTH:

@@ -34,11 +34,16 @@ def gesummv(n: int) -> Program:
             Ref("T0", "tmp", level=0, coeffs=(1,)),
             Ref("Y0", "y", level=0, coeffs=(1,)),
             Ref("A0", "A", level=1, coeffs=(n, 1)),
-            Ref("X0", "x", level=1, coeffs=(0, 1), share_threshold=thr),
+            # x[j] is read by BOTH statements: the duplicated map is two
+            # loads, not a read-modify-write pair (write=False keeps the
+            # race detector from deriving a store here)
+            Ref("X0", "x", level=1, coeffs=(0, 1), share_threshold=thr,
+                write=False),
             Ref("T1", "tmp", level=1, coeffs=(1, 0)),
             Ref("T2", "tmp", level=1, coeffs=(1, 0)),
             Ref("B0", "B", level=1, coeffs=(n, 1)),
-            Ref("X1", "x", level=1, coeffs=(0, 1), share_threshold=thr),
+            Ref("X1", "x", level=1, coeffs=(0, 1), share_threshold=thr,
+                write=False),
             Ref("Y1", "y", level=1, coeffs=(1, 0)),
             Ref("Y2", "y", level=1, coeffs=(1, 0)),
             Ref("T3", "tmp", level=0, coeffs=(1,), slot="post"),

@@ -29,11 +29,16 @@ from ..ir import Loop, ParallelNest, Program, Ref
 def _stencil_refs(read: str, write: str, n: int) -> tuple[Ref, ...]:
     c = (n * n, n, 1)
     reads = [n * n, 0, -n * n, n, 0, -n, 1, 0, -1, 0]
+    # the center point repeats four times among the RHS reads; write=False
+    # keeps the race detector's duplicated-map convention from deriving a
+    # store out of them (the store goes to the OTHER array)
     refs = [
-        Ref(f"{read.upper()}{k}", read, level=2, coeffs=c, const=d)
+        Ref(f"{read.upper()}{k}", read, level=2, coeffs=c, const=d,
+            write=False)
         for k, d in enumerate(reads)
     ]
-    refs.append(Ref(f"{write.upper()}W", write, level=2, coeffs=c))
+    refs.append(Ref(f"{write.upper()}W", write, level=2, coeffs=c,
+                    write=True))
     return tuple(refs)
 
 

@@ -137,6 +137,21 @@ def drift_audit(
         "mrc_digest_sampled": obs_ledger.mrc_digest(mrc_sampled),
         **metrics,
     }
+    # static per-model priors (analysis/bounds.py): the facts the
+    # audit row lets an offline reader sanity-check BOTH curves
+    # against (compulsory-miss floor, exact cold footprint) — and the
+    # exact curve is cross-checked right here, so a drift audit also
+    # gates the analyzer's own bounds
+    try:
+        from ... import analysis
+
+        report = analysis.analyze_program(program, machine)
+        row["static_priors"] = analysis.drift_priors(report)
+        row["static_bounds_violations"] = analysis.check_static_bounds(
+            report, mrc_exact, machine
+        )
+    except Exception as e:  # priors are advisory, never sink an audit
+        row["static_priors"] = {"error": repr(e)}
     if breach:
         telemetry.count("drift_breach")
         telemetry.event(

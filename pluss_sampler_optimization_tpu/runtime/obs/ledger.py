@@ -174,6 +174,11 @@ def validate_row(row) -> list[str]:
             need_num("replica_id", nullable=True)
         if "request" in row and not isinstance(row["request"], dict):
             errors.append("'request' must be an object")
+        # ir-preflight verdict (service/api.py static-analysis gate):
+        # "ok" | "race" on served rows, "invalid" on rejection rows —
+        # optional, rows from preflight-disabled services omit it
+        if "preflight" in row:
+            need_str("preflight", nullable=True)
     elif kind == "drift":
         need_str("model")
         need_num("n")
@@ -276,7 +281,8 @@ def aggregate(rows: list[dict]) -> dict:
     # report): one row per non-coalesced submit, plus the row's
     # `coalesced` count for singleflight joiners
     service = {"submitted": 0, "coalesced": 0, "completed": 0,
-               "failed": 0, "degraded": 0}
+               "failed": 0, "degraded": 0, "preflight_rejected": 0,
+               "race_flagged": 0}
     # per-replica occupancy at execution grain: one request row per
     # served execution, grouped by the replica that ran it — the
     # ledger face of the executor's `replicas` snapshot and the
@@ -293,6 +299,11 @@ def aggregate(rows: list[dict]) -> dict:
                 service["completed" if row["ok"] else "failed"] += 1
                 if row.get("degraded"):
                     service["degraded"] += 1
+                pf = row.get("preflight")
+                if pf == "invalid":
+                    service["preflight_rejected"] += 1
+                elif pf == "race":
+                    service["race_flagged"] += 1
             rid = row.get("replica_id")
             if rid is not None:
                 r = replicas.setdefault(
@@ -423,6 +434,15 @@ def format_stats(agg: dict) -> list[str]:
                 b["batches"], b["batched_requests"],
                 b["occupancy_p50"], b["occupancy_p95"],
                 b["batched_p50_latency_s"], b["solo_p50_latency_s"],
+            )
+        )
+    svc = agg.get("service") or {}
+    if svc.get("preflight_rejected") or svc.get("race_flagged"):
+        lines.append(
+            "preflight: %d rejected (invalid IR), %d served with a "
+            "race verdict" % (
+                svc.get("preflight_rejected", 0),
+                svc.get("race_flagged", 0),
             )
         )
     reps = agg.get("replicas")

@@ -84,6 +84,9 @@ each a batched device dispatch.
 
 from __future__ import annotations
 
+import functools
+import re
+
 import numpy as np
 
 from ..config import MachineConfig
@@ -111,34 +114,103 @@ _COLD_KEY = "cold"
 # tools/verify_analytic.py audits. `run_exact`'s analytic route warns
 # (stderr + telemetry event) for any family outside this set: those
 # inherit the probe-backed verification ledger (module docstring), not
-# a proof. Names match the Program.name prefix before the size suffix.
+# a proof. Names record the *provenance* of the audits (the
+# Program.name prefix before the size suffix); the membership test
+# itself is signature-derived — see `audited_family`.
 AUDITED_FAMILIES = frozenset({
     "gemm", "syrk", "syrk-tri", "trmm", "trisolv", "covariance",
     "adi", "fdtd2d",
 })
 
 
-def audited_family(name: str) -> bool:
-    """True when a Program.name belongs to an audited family (the name
-    is the family followed by a size suffix, e.g. 'syrk-tri-24x24')."""
-    for fam in AUDITED_FAMILIES:
-        if name == fam or (
-            name.startswith(fam + "-")
-            and name[len(fam) + 1: len(fam) + 2].isdigit()
-        ):
-            return True
-    return False
+@functools.lru_cache(maxsize=None)
+def _registry_family_builders() -> dict:
+    """family name (Program.name prefix) -> (builder, takes_tsteps)
+    for every registry model, so a bare name can be re-anchored to the
+    IR its family's builder produces."""
+    import inspect
+
+    from ..models import REGISTRY
+
+    out: dict = {}
+    for fn in REGISTRY.values():
+        has_t = "tsteps" in inspect.signature(fn).parameters
+        prog = fn(8, tsteps=1) if has_t else fn(8)
+        out[re.split(r"-\d", prog.name)[0]] = (fn, has_t)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _audited_signatures(families: frozenset) -> frozenset:
+    """Structural signatures of the audited families' IR.
+
+    The audits in tests/test_analytic.py pin exactness of the analytic
+    route against the oracle for specific loop-nest STRUCTURES, and
+    the structure — not the name — is what the route dispatches on.
+    Deriving the membership set from `structural_signature` over the
+    registry builders (token size n=8; signatures are size-invariant,
+    verified by tests/test_analysis.py) means a renamed or aliased
+    registry entry with an audited structure stays audited, and a
+    same-named model whose builder diverges from the audited IR stops
+    silently inheriting the proof. Time-axis models are seeded at
+    tsteps in {1, 2, 3}: fdtd2d's first time step lacks the previous
+    iteration's state, so ts=1/ts=2/ts>=3 are three distinct (all
+    audited) signature variants."""
+    from ..analysis.validate import structural_signature
+
+    sigs = set()
+    for fam, (fn, has_t) in _registry_family_builders().items():
+        if fam not in families:
+            continue
+        for ts in (1, 2, 3) if has_t else (1,):
+            prog = fn(8, tsteps=ts) if has_t else fn(8)
+            sigs.add(structural_signature(prog))
+    return frozenset(sigs)
+
+
+def audited_family(name_or_program) -> bool:
+    """True when a Program (or a Program.name, e.g. 'syrk-tri-24x24')
+    has the structural signature of an audited family.
+
+    A Program is matched by its own signature. A bare name is mapped
+    family -> registry builder -> signature (rebuilt at a token size;
+    for time-axis names the '-t<k>' suffix picks the signature
+    variant); names from families the registry does not know fall back
+    to plain `AUDITED_FAMILIES` membership."""
+    families = AUDITED_FAMILIES  # module attr: tests monkeypatch it
+    sigs = _audited_signatures(families)
+    if isinstance(name_or_program, Program):
+        from ..analysis.validate import structural_signature
+
+        return structural_signature(name_or_program) in sigs
+    name = name_or_program
+    fam = re.split(r"-\d", name)[0]
+    builders = _registry_family_builders()
+    if fam not in builders:
+        return fam in families
+    fn, has_t = builders[fam]
+    if not has_t:
+        return structural_signature_of(fn(8)) in sigs
+    m = re.search(r"-t(\d+)$", name)
+    ts = min(int(m.group(1)), 3) if m else 1
+    return structural_signature_of(fn(8, tsteps=max(ts, 1))) in sigs
+
+
+def structural_signature_of(program: Program):
+    """Thin call-time import shim (keeps module import free of the
+    analysis package)."""
+    from ..analysis.validate import structural_signature
+
+    return structural_signature(program)
 
 
 def warn_if_unaudited(program: Program) -> None:
     """Exact-router guard (ADVICE round 5, medium): emit a telemetry
     event + one-line stderr warning (once per family per process) when
-    the analytic route serves a model family outside the audited
-    allowlist, instead of silently claiming bit-exactness."""
-    if audited_family(program.name):
+    the analytic route serves a model whose structure is outside the
+    audited set, instead of silently claiming bit-exactness."""
+    if audited_family(program):
         return
-    import re
-
     family = re.split(r"-\d", program.name)[0]
     telemetry.warn_once(
         ("analytic_unaudited", family),

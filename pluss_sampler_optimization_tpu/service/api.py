@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 from typing import IO
 
 import numpy as np
@@ -179,6 +180,10 @@ class AnalysisResponse:
     # cache hit, no pool, or failure before execution). Serving
     # metadata only — MRC bytes are identical whichever replica ran
     replica_id: int | None = None
+    # ir-preflight summary ({"verdict": "ok"|"race", "races": N}) from
+    # the static-analysis gate; None when preflight is disabled.
+    # Serving metadata: the verdict never shapes the MRC bytes
+    preflight: dict | None = None
 
     def to_jsonl_dict(self) -> dict:
         """The wire form `serve` emits: compact — the MRC ships in the
@@ -204,6 +209,8 @@ class AnalysisResponse:
             d["span_id"] = self.span_id
         if self.replica_id is not None:
             d["replica_id"] = self.replica_id
+        if self.preflight is not None:
+            d["preflight"] = self.preflight
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
@@ -233,6 +240,7 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             trace_id=outcome.get("trace_id"),
             span_id=outcome.get("span_id"),
             replica_id=outcome.get("replica_id"),
+            preflight=outcome.get("preflight"),
         )
     return AnalysisResponse(
         id=request.id,
@@ -254,6 +262,7 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         trace_id=outcome.get("trace_id"),
         span_id=outcome.get("span_id"),
         replica_id=outcome.get("replica_id"),
+        preflight=outcome.get("preflight"),
     )
 
 
@@ -267,11 +276,19 @@ class AnalysisService:
                  ledger_path: str | None = None,
                  batch_window_ms: float | None = None,
                  batch_max_refs: int = 64,
-                 replicas=None):
+                 replicas=None,
+                 preflight: bool = True):
         from ..config import BatchConfig
 
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
         self.ledger_path = ledger_path
+        # static-analysis gate (analysis/__init__.py): validates the
+        # IR before fingerprint/cache and attaches the verdict to
+        # responses/ledger rows. Off is a debugging escape hatch —
+        # MRC bytes are bit-identical either way (the analyzer never
+        # touches the engines; pinned by tests/test_analysis.py)
+        self.preflight = preflight
+        self._preflight_memo: dict = {}
         # optional runtime/obs/slo.py sentinel, attached by the CLI
         # serve mode so the `metrics` request can report the latest
         # SLO evaluation alongside the registry snapshot
@@ -401,14 +418,100 @@ class AnalysisService:
             out["slo"] = self.slo_sentinel.last_report
         return out
 
+    def _run_preflight(self, request: AnalysisRequest,
+                       program: Program) -> dict:
+        """The static-analysis gate, run before fingerprint/cache.
+
+        Returns the compact preflight summary that rides the outcome/
+        response/ledger row; raises `analysis.PreflightError` (with
+        machine-readable diagnostics attached) for invalid IR —
+        nothing is fingerprinted, cached, or executed for a rejected
+        request, and the rejection leaves its own ledger row.
+
+        The verdict is a pure function of (IR, machine), so it is
+        memoized per (model, n, tsteps, machine): repeat submissions
+        of a warm request skip the analyzer entirely. The per-request
+        preflight latency (memo hits included) lands in the
+        `request_preflight_s` stage histogram."""
+        from .. import analysis
+        from ..runtime import telemetry
+        from ..runtime.obs import metrics as obs_metrics
+
+        t0 = time.perf_counter()
+        key = (request.model, request.n, request.tsteps,
+               dataclasses.astuple(request.machine()))
+        summary = self._preflight_memo.get(key)
+        if summary is None:
+            with telemetry.span("ir_preflight", model=request.model,
+                                program=program.name,
+                                trace_id=request.trace_id):
+                report = analysis.analyze_program(
+                    program, request.machine()
+                )
+            summary = report.summary()
+            if len(self._preflight_memo) >= 256:
+                self._preflight_memo.clear()
+            self._preflight_memo[key] = summary
+        obs_metrics.observe("request_preflight_s",
+                            time.perf_counter() - t0,
+                            exemplar=request.trace_id)
+        if summary["verdict"] == analysis.VERDICT_INVALID:
+            diags = summary.get("diagnostics") or []
+            first = diags[0]
+            msg = (f"ir preflight rejected {program.name!r}: "
+                   f"{first['code']} at {first['path']}: "
+                   f"{first['message']}")
+            if len(diags) > 1:
+                msg += f" (+{len(diags) - 1} more)"
+            self.executor._count("preflight_rejected")
+            self._ledger_rejection(request, msg)
+            raise analysis.PreflightError(msg, diagnostics=diags)
+        if summary.get("races"):
+            self.executor._count("race_warnings", summary["races"])
+        return summary
+
+    def _ledger_rejection(self, request: AnalysisRequest,
+                          msg: str) -> None:
+        """One `preflight: invalid` request row per rejection — the
+        ledger's view of the `ir_preflight_failures` counter
+        (check_ledger --stats aggregates it). Never sinks the
+        rejection response."""
+        if not self.ledger_path:
+            return
+        from ..runtime.obs import ledger as obs_ledger
+
+        row = {
+            "kind": "request", "source": "service", "ok": False,
+            "fingerprint": None,
+            "engine_requested": request.engine, "engine_used": None,
+            "model": request.model, "n": request.n,
+            "latency_s": None, "cache": None, "degraded": [],
+            "mrc_digest": None,
+            "preflight": "invalid",
+            "error": msg[:300],
+        }
+        if request.trace_id is not None:
+            row["trace_id"] = request.trace_id
+        try:
+            obs_ledger.append(self.ledger_path, row)
+            self.executor._count("ledger_rows")
+        except Exception:
+            self.executor._count("ledger_write_failed")
+
     def submit(self, request: AnalysisRequest) -> AnalysisTicket:
-        """Validate, fingerprint, and schedule (or join) a request.
-        Raises ValueError/KeyError for malformed requests — `serve`
-        turns those into per-line error responses."""
+        """Validate, preflight, fingerprint, and schedule (or join) a
+        request. Raises ValueError/KeyError for malformed requests
+        (PreflightError for invalid IR) — `serve` turns those into
+        per-line error responses."""
         program = request.build_program()
+        preflight = (
+            self._run_preflight(request, program)
+            if self.preflight else None
+        )
         fp = request.fingerprint(program)
         fut = self.executor.submit(
-            request, program, request.machine(), fp
+            request, program, request.machine(), fp,
+            preflight=preflight,
         )
         return AnalysisTicket(request=request, fingerprint=fp,
                               future=fut)
@@ -526,6 +629,12 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
             entry["request"] = request
         except Exception as e:
             entry["error"] = _error_msg(e)
+            # preflight rejections carry machine-readable diagnostics
+            # (code / nest-ref path / message) — surface them on the
+            # structured error response
+            diags = getattr(e, "diagnostics", None)
+            if diags:
+                entry["diagnostics"] = diags
     failures = 0
     for entry in entries:
         if "control" in entry:
@@ -565,6 +674,8 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
                 "line": entry["line"],
                 "error": entry["error"],
             }
+            if entry.get("diagnostics"):
+                doc["diagnostics"] = entry["diagnostics"]
         out_stream.write(json.dumps(doc) + "\n")
         out_stream.flush()
     return failures

@@ -260,6 +260,9 @@ class _BatchEntry:
     # enqueued_at..flushed_at interval is the member's batch_wait
     # stage, flushed_at..execution-start its (pool) queue stage
     flushed_at: float | None = None
+    # ir-preflight summary dict (verdict/races) from the service's
+    # static-analysis gate, riding along to outcome/response/ledger
+    preflight: object = None
 
 
 class BatchScheduler:
@@ -473,7 +476,8 @@ class RequestExecutor:
                     "degraded", "deadline_abandoned", "active",
                     "ledger_rows", "ledger_write_failed",
                     "batches_formed", "batch_members",
-                    "batch_fallback_solo"):
+                    "batch_fallback_solo", "preflight_rejected",
+                    "race_warnings"):
             out.setdefault(key, 0)
         active = out.pop("active")
         out["in_flight"] = inflight
@@ -534,6 +538,8 @@ class RequestExecutor:
         "batches_formed": "batches_formed",
         "batch_members": "batch_members",
         "batch_fallback_solo": "service_batch_fallback_solo",
+        "preflight_rejected": "ir_preflight_failures",
+        "race_warnings": "race_warnings",
     }
 
     def _count(self, key: str, inc: int = 1) -> None:
@@ -546,13 +552,18 @@ class RequestExecutor:
     # -- public -------------------------------------------------------
 
     def submit(self, request, program: Program,
-               machine: MachineConfig, fingerprint: str) -> Future:
+               machine: MachineConfig, fingerprint: str,
+               preflight: dict | None = None) -> Future:
         """Schedule (or join) the execution for one fingerprint.
 
         The returned future resolves to the full response dict (record
         + serving metadata). Identical fingerprints submitted while
         one is in flight share its future (and its trace/span ids —
-        one execution, one span, N joined callers)."""
+        one execution, one span, N joined callers). `preflight` is the
+        service's static-analysis summary (verdict/races); it rides
+        the outcome into the response and the ledger row. Coalesced
+        joiners share the executing request's summary — same
+        fingerprint, same IR, same verdict."""
         telemetry.count("service_requests")
         telemetry.count("service_submitted")
         if getattr(request, "trace_id", None) is None:
@@ -593,11 +604,12 @@ class RequestExecutor:
                         None if request.deadline_s is None
                         else time.perf_counter() + request.deadline_s
                     ),
+                    preflight=preflight,
                 )
             else:
                 fut = self._pool.submit(
                     self._process, request, program, machine,
-                    fingerprint, submitted_at,
+                    fingerprint, submitted_at, preflight,
                 )
             self._inflight[fingerprint] = fut
             telemetry.gauge("service_queue_depth", len(self._inflight))
@@ -684,7 +696,8 @@ class RequestExecutor:
 
     def _process(self, request, program, machine,
                  fingerprint: str,
-                 submitted_at: float | None = None) -> dict:
+                 submitted_at: float | None = None,
+                 preflight: dict | None = None) -> dict:
         start = time.perf_counter()
         t0 = submitted_at if submitted_at is not None else start
         queue_s = None if submitted_at is None else start - submitted_at
@@ -739,6 +752,7 @@ class RequestExecutor:
             "queue_s": queue_s,
             "execute_s": execute_s,
             "replica_id": replica_id,
+            "preflight": preflight,
         }
         self._observe_stages(outcome, queue_s=queue_s,
                              execute_s=execute_s, fetch_s=fetch_s)
@@ -1031,6 +1045,8 @@ class RequestExecutor:
                 batch_id: str | None = None,
                 batch_members: int | None = None) -> None:
         """Ledger + future resolution for one batch member."""
+        if e.preflight is not None:
+            outcome.setdefault("preflight", e.preflight)
         if self.ledger_path:
             extra = {}
             if batch_id is not None:
@@ -1093,6 +1109,12 @@ class RequestExecutor:
             row["request"] = request.payload()
         except Exception:
             pass
+        pf = outcome.get("preflight")
+        if isinstance(pf, dict) and pf.get("verdict"):
+            # schema-v2 optional field: the preflight verdict string
+            # ("ok" | "race"; rejections write their own row from the
+            # service with verdict "invalid")
+            row["preflight"] = pf["verdict"]
         for stage in ("queue_s", "batch_wait_s", "execute_s"):
             v = outcome.get(stage)
             if v is not None:

@@ -46,7 +46,7 @@ from ..ir import Program
 # result-record schema (service/cache.py::STORE_VERSION documents the
 # record side); old on-disk entries then miss cleanly instead of being
 # misinterpreted.
-FINGERPRINT_VERSION = 1
+FINGERPRINT_VERSION = 2  # v2: ir.Ref grew the `write` marker field
 
 
 def _canonical(obj):

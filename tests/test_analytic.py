@@ -8,6 +8,8 @@ for every rejected family at several N, including non-power-of-two N
 (multi-phase classes) and machine-geometry variations.
 """
 
+import re
+
 import numpy as np
 import pytest
 
@@ -177,3 +179,43 @@ def test_analytic_count_identity_guard():
         sum(sum(hh.values()) for hh in h.values()) for h in a.state.share
     )
     assert total_folded == a.total_accesses
+
+
+def test_audited_family_parity_with_name_prefix_matcher():
+    """audited_family is now derived from structural signatures of the
+    audited builders, not name prefixes. Pin exact parity with the old
+    prefix matcher across the whole registry (names and Programs), so
+    the warning surface is unchanged, and keep the monkeypatch
+    contract: shrinking AUDITED_FAMILIES shrinks the audited set."""
+    from pluss_sampler_optimization_tpu.sampler import analytic
+
+    def old_matcher(name: str) -> bool:
+        fam = re.split(r"-\d", name)[0]
+        return fam in analytic.AUDITED_FAMILIES
+
+    for name in sorted(REGISTRY):
+        for n in (8, 24):
+            for tsteps in (1, 3):
+                try:
+                    prog = REGISTRY[name](n, tsteps=tsteps)
+                except TypeError:
+                    if tsteps != 1:
+                        continue
+                    prog = REGISTRY[name](n)
+                want = old_matcher(prog.name)
+                assert analytic.audited_family(prog.name) == want, (
+                    name, n, tsteps)
+                assert analytic.audited_family(prog) == want, (
+                    name, n, tsteps)
+    # unregistered families fall back to plain membership
+    assert not analytic.audited_family("mystery-64")
+    # monkeypatch contract (test_telemetry relies on this): dropping a
+    # family from AUDITED_FAMILIES un-audits its programs
+    orig = analytic.AUDITED_FAMILIES
+    try:
+        analytic.AUDITED_FAMILIES = frozenset(orig - {"gemm"})
+        assert not analytic.audited_family(REGISTRY["gemm"](8))
+        assert analytic.audited_family(REGISTRY["syrk"](8))
+    finally:
+        analytic.AUDITED_FAMILIES = orig
+    assert analytic.audited_family(REGISTRY["gemm"](8))

@@ -262,6 +262,15 @@ def test_bench_emits_json_line(tmp_path):
     assert doc["extra"]["mrc_digest"]
     assert doc["extra"]["analytic_exact"]["engine"] == "analytic"
     assert doc["extra"]["analytic_exact"]["mrc_l1_err"] == 0.0
+    # static-analyzer evidence: every registry model analyzed, timed,
+    # and carrying its pinned verdict
+    ip = doc["extra"]["ir_preflight"]
+    assert "error" not in ip
+    assert len(ip["models"]) == 18
+    assert ip["models"]["gemm"]["verdict"] == "ok"
+    assert ip["models"]["bicg"]["verdict"] == "race"
+    assert ip["models"]["bicg"]["races"] == 3
+    assert ip["total_wall_ms"] > 0
     assert doc["unit"] == "samples/s/chip"
     assert doc["value"] == final["value"]
     assert doc["vs_baseline"] > 0  # native baseline must have run

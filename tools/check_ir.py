@@ -1,0 +1,139 @@
+"""Static IR gate: analyzer verdicts for the model registry.
+
+Runs the three analysis passes
+(pluss_sampler_optimization_tpu/analysis/) over every registry model —
+or one model with --model — and prints the verdict table the README
+"Static analysis & preflight" section documents: well-formedness
+diagnostics, the dependence/race classification, and the locality
+bounds. No jax import, so the gate is instant.
+
+    python tools/check_ir.py [--model NAME] [--n N] [--tsteps T]
+        [--json] [--fixtures]
+
+Exit code: nonzero when any program is INVALID (verdict "invalid") —
+a race verdict is a property of the modeled OpenMP program, not an
+input error, and exits 0. `--fixtures` instead runs the analyzer over
+the malformed-IR fixture set (analysis/validate.py::malformed_fixtures)
+and fails unless every fixture produces exactly its expected
+diagnostic code — the error-path self-test the service preflight
+rejection shares (tests/test_analysis.py runs both from tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def verdict_rows(models, n: int, tsteps: int):
+    """[(name, report)] for the requested registry models."""
+    from pluss_sampler_optimization_tpu import analysis
+    from pluss_sampler_optimization_tpu.config import MachineConfig
+    from pluss_sampler_optimization_tpu.models import build
+
+    machine = MachineConfig()
+    rows = []
+    for name in models:
+        program = build(name, n, tsteps)
+        rows.append((name, analysis.analyze_program(program, machine)))
+    return rows
+
+
+def check_fixtures() -> list[str]:
+    """Run every malformed fixture through the analyzer; returns the
+    mismatches (empty = every fixture yields its expected code)."""
+    from pluss_sampler_optimization_tpu import analysis
+
+    problems = []
+    for key, (program, want_code) in sorted(
+        analysis.malformed_fixtures().items()
+    ):
+        report = analysis.analyze_program(program)
+        if report.verdict != analysis.VERDICT_INVALID:
+            problems.append(
+                f"{key}: expected verdict 'invalid', got "
+                f"{report.verdict!r}"
+            )
+            continue
+        codes = [d.code for d in report.diagnostics
+                 if d.severity == "error"]
+        if want_code not in codes:
+            problems.append(
+                f"{key}: expected diagnostic {want_code}, got {codes}"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="static IR analyzer gate over the model registry"
+    )
+    ap.add_argument("--model", default=None,
+                    help="one registry model (default: all)")
+    ap.add_argument("--n", type=int, default=24)
+    ap.add_argument("--tsteps", type=int, default=1)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object per model instead of "
+                    "the table")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="check the malformed-IR fixture set instead "
+                    "of the registry (error-path self-test)")
+    args = ap.parse_args(argv)
+
+    if args.fixtures:
+        problems = check_fixtures()
+        for p in problems:
+            print(f"FIXTURE MISMATCH: {p}", file=sys.stderr)
+        from pluss_sampler_optimization_tpu import analysis
+
+        n = len(analysis.malformed_fixtures())
+        print(f"fixtures: {n - len(problems)}/{n} produced their "
+              "expected diagnostic code")
+        return 1 if problems else 0
+
+    from pluss_sampler_optimization_tpu.models import REGISTRY
+
+    models = [args.model] if args.model else sorted(REGISTRY)
+    rows = verdict_rows(models, args.n, args.tsteps)
+    invalid = 0
+    if args.json:
+        for name, report in rows:
+            doc = {"model": name, **report.summary(),
+                   "wall_ms": round(report.wall_s * 1e3, 3)}
+            if report.races:
+                doc["race_pairs"] = [
+                    (r.ref_a, r.ref_b) for r in report.races
+                ]
+            print(json.dumps(doc, sort_keys=True))
+            invalid += 0 if report.ok else 1
+        return 1 if invalid else 0
+    print(f"{'model':<12} {'verdict':>8} {'races':>5} {'deps':>5} "
+          f"{'carried':>7} {'compulsory':>10} {'ms':>7}")
+    for name, report in rows:
+        from pluss_sampler_optimization_tpu import analysis
+
+        if not report.ok:
+            invalid += 1
+            first = next(d for d in report.diagnostics
+                         if d.severity == "error")
+            print(f"{name:<12} {'INVALID':>8}  {first.code} at "
+                  f"{first.path}: {first.message}")
+            continue
+        carried = sum(1 for d in report.dependences
+                      if d.kind == analysis.DEP_CARRIED)
+        print(f"{name:<12} {report.verdict:>8} "
+              f"{len(report.races):>5} {len(report.dependences):>5} "
+              f"{carried:>7} {report.bounds.compulsory_lower:>10} "
+              f"{report.wall_s * 1e3:>7.1f}")
+    print(f"{len(rows)} models, {invalid} invalid")
+    return 1 if invalid else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

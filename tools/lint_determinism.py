@@ -1,0 +1,240 @@
+"""AST lint for the bit-identity hot spots.
+
+The repo's serving story rests on a handful of functions whose output
+must be a pure value function of their inputs: the request
+fingerprint (service/fingerprint.py — cache addresses), the CRI
+distribution and histogram folds (runtime/cri.py, runtime/hist.py —
+the MRC bytes themselves), and the ledger's MRC digest
+(runtime/obs/ledger.py::mrc_digest — the cross-run attribution key).
+A wall-clock read, an RNG draw, a PYTHONHASHSEED-dependent `hash()`,
+or iteration over an unordered set silently breaks the bit-identity
+contract tier-1 pins everywhere else.
+
+This lint walks the AST of those targets and reports:
+
+  wallclock   time.time / time.time_ns / datetime.now / utcnow
+  entropy     random.* / np.random.* / numpy.random.* / os.urandom /
+              uuid.uuid4 / secrets.*
+  hashseed    the builtin hash() (PYTHONHASHSEED-dependent)
+  set-order   a for-loop or comprehension iterating a set literal,
+              set/frozenset() call, or set comprehension without a
+              sorted(...) wrapper (iteration order is salted)
+
+Violation ids are `relpath::qualname::rule`; lines in
+tools/lint_determinism_allow.txt (one id per line, '#' comments)
+suppress a finding after human review. tests/test_analysis.py runs
+the lint from tier-1 (clean run required) and checks it still
+catches synthetic violations.
+
+    python tools/lint_determinism.py [--list-targets]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+PKG = "pluss_sampler_optimization_tpu"
+
+# (relative path, qualname prefix or None for the whole file)
+TARGETS = (
+    (f"{PKG}/service/fingerprint.py", None),
+    (f"{PKG}/runtime/cri.py", None),
+    (f"{PKG}/runtime/hist.py", None),
+    (f"{PKG}/runtime/obs/ledger.py", "mrc_digest"),
+)
+
+ALLOWLIST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "lint_determinism_allow.txt",
+)
+
+# dotted-name bans: exact names, or prefixes ending in "."
+_WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
+              "datetime.utcnow", "datetime.datetime.now",
+              "datetime.datetime.utcnow"}
+_ENTROPY_EXACT = {"os.urandom", "uuid.uuid4"}
+_ENTROPY_PREFIX = ("random.", "np.random.", "numpy.random.",
+                   "secrets.")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str  # repo-relative
+    qualname: str
+    rule: str
+    line: int
+    detail: str
+
+    @property
+    def id(self) -> str:
+        return f"{self.path}::{self.qualname}::{self.rule}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line} [{self.rule}] {self.detail}"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c" when the chain roots in a bare Name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.stack: list[str] = []
+        self.violations: list[Violation] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def _flag(self, rule: str, node: ast.AST, detail: str) -> None:
+        self.violations.append(Violation(
+            path=self.path, qualname=self.qualname, rule=rule,
+            line=getattr(node, "lineno", 0), detail=detail))
+
+    # -- scoping ------------------------------------------------------
+
+    def _scoped(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+    # -- rules --------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if name is not None:
+            if name in _WALLCLOCK:
+                self._flag("wallclock", node, f"call to {name}()")
+            elif name in _ENTROPY_EXACT or name.startswith(
+                _ENTROPY_PREFIX
+            ):
+                self._flag("entropy", node, f"call to {name}()")
+        if isinstance(node.func, ast.Name) and node.func.id == "hash":
+            self._flag(
+                "hashseed", node,
+                "builtin hash() is PYTHONHASHSEED-dependent; use "
+                "hashlib over a canonical encoding",
+            )
+        self.generic_visit(node)
+
+    def _check_iter(self, node: ast.AST, it: ast.AST) -> None:
+        if _is_set_expr(it):
+            self._flag(
+                "set-order", node,
+                "iterating an unordered set; wrap in sorted(...)",
+            )
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def _comp(self, node) -> None:
+        for gen in node.generators:
+            self._check_iter(node, gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _comp
+    visit_SetComp = _comp
+    visit_DictComp = _comp
+    visit_GeneratorExp = _comp
+
+
+def lint_source(source: str, path: str,
+                qualname: str | None = None) -> list[Violation]:
+    """Lint one file's source; restrict to `qualname` (a top-level
+    def/class name) when given."""
+    tree = ast.parse(source, filename=path)
+    if qualname is not None:
+        body = [n for n in tree.body
+                if getattr(n, "name", None) == qualname]
+        if not body:
+            return [Violation(path=path, qualname=qualname,
+                              rule="missing", line=0,
+                              detail=f"target {qualname!r} not found")]
+        tree = ast.Module(body=body, type_ignores=[])
+    linter = _Linter(path)
+    linter.visit(tree)
+    return linter.violations
+
+
+def read_allowlist(path: str = ALLOWLIST_PATH) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                out.add(line)
+    return out
+
+
+def run_lint(repo_root: str | None = None,
+             targets=TARGETS,
+             allowlist: set[str] | None = None) -> list[Violation]:
+    """Lint every target file; returns unallowed violations."""
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    allow = read_allowlist() if allowlist is None else allowlist
+    out: list[Violation] = []
+    for rel, qual in targets:
+        with open(os.path.join(root, rel)) as f:
+            source = f.read()
+        out.extend(
+            v for v in lint_source(source, rel, qual)
+            if v.id not in allow
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="determinism lint over the bit-identity hot spots"
+    )
+    ap.add_argument("--list-targets", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_targets:
+        for rel, qual in TARGETS:
+            print(f"{rel}" + (f"::{qual}" if qual else ""))
+        return 0
+    violations = run_lint()
+    for v in violations:
+        print(str(v), file=sys.stderr)
+    n = len(TARGETS)
+    print(f"determinism lint: {n} targets, {len(violations)} "
+          "violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
