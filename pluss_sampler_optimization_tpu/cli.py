@@ -487,6 +487,48 @@ def main(argv=None) -> int:
         help="SLO sentinel evaluation period (default 10); a final "
         "evaluation always runs when the serve batch completes",
     )
+    ap.add_argument(
+        "--debug-bundle-dir",
+        default=None,
+        metavar="DIR",
+        help="serve mode: run the flight recorder — a bounded ring "
+        "of per-request records with tail-based retention (errors, "
+        "degradations, drift breaches, latency outliers kept) that "
+        "writes an atomic schema-versioned post-mortem bundle under "
+        "DIR on SLO breach, request failure, replica quarantine, "
+        "drift breach, perf regression, an explicit dump_debug "
+        "request, or SIGUSR2. See README \"Flight recorder & "
+        "post-mortems\".",
+    )
+    ap.add_argument(
+        "--regress-bench",
+        default=None,
+        metavar="GLOB",
+        help="serve mode: additionally feed BENCH_r*.json evidence "
+        "files matching GLOB into the SLO sentinel's perf-regression "
+        "leg (the ledger tail is always evaluated when --ledger is "
+        "set); a breach counts perf_regression and triggers a "
+        "post-mortem bundle",
+    )
+    ap.add_argument(
+        "--ledger-gc-interval-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="serve mode: compact the run ledger in the background "
+        "every SECONDS (atomic rewrite dropping invalid lines and "
+        "rows beyond --ledger-max-rows), so soak runs don't grow it "
+        "unbounded; GC passes are counted in the live registry "
+        "(ledger_gc_runs / ledger_gc_dropped). Needs --ledger.",
+    )
+    ap.add_argument(
+        "--ledger-max-rows",
+        type=int,
+        default=0,
+        metavar="N",
+        help="with --ledger-gc-interval-s: keep only the newest N "
+        "rows at each GC pass (0 = drop only invalid lines)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_models:
@@ -537,6 +579,28 @@ def main(argv=None) -> int:
                 "apply to serve mode only (offline ledgers are gated "
                 "by tools/check_slo.py)"
             )
+        if args.debug_bundle_dir is not None:
+            raise SystemExit(
+                "--debug-bundle-dir runs the serving flight "
+                "recorder; it applies to serve mode only"
+            )
+        if args.regress_bench is not None:
+            raise SystemExit(
+                "--regress-bench feeds the serving perf-regression "
+                "sentinel; it applies to serve mode only (offline "
+                "history is gated by tools/check_regression.py)"
+            )
+        if args.ledger_gc_interval_s is not None:
+            raise SystemExit(
+                "--ledger-gc-interval-s runs background ledger "
+                "compaction for serve mode only (offline ledgers are "
+                "compacted by tools/check_ledger.py --gc)"
+            )
+    if args.ledger_gc_interval_s is not None and not args.ledger:
+        raise SystemExit(
+            "--ledger-gc-interval-s compacts the run ledger; it "
+            "needs --ledger PATH"
+        )
 
     if args.replicas is not None and args.replicas < 0:
         raise SystemExit("--replicas must be >= 0 (0 = auto, one "
@@ -767,9 +831,12 @@ def _request_from_args(args, engine):
 def _serve(args) -> int:
     """`serve` mode: process a JSONL request batch end to end, under
     the live metrics registry (always on here — the `metrics` request
-    type and the optional --metrics-port scrape read it) and the
-    optional SLO sentinel."""
+    type and the optional --metrics-port scrape read it), the
+    optional SLO sentinel, the optional flight recorder
+    (--debug-bundle-dir), and the optional background ledger GC."""
+    from .runtime.obs import ledger as obs_ledger
     from .runtime.obs import metrics as obs_metrics
+    from .runtime.obs import recorder as obs_recorder
     from .service import AnalysisService, serve_jsonl
 
     fin = sys.stdin if args.requests == "-" else open(args.requests)
@@ -780,15 +847,48 @@ def _serve(args) -> int:
     registry = obs_metrics.enable()
     server = None
     sentinel = None
-    if args.metrics_port is not None:
-        server = obs_metrics.MetricsServer(
-            registry, port=args.metrics_port
+    recorder = None
+    gc = None
+    prev_usr2 = None
+    if args.debug_bundle_dir is not None:
+        recorder = obs_recorder.enable(
+            args.debug_bundle_dir,
+            ledger_path=args.ledger,
+            # the resolved serving config rides every bundle, so a
+            # post-mortem reader knows exactly what was running
+            config={
+                k: getattr(args, k)
+                for k in (
+                    "cache_dir", "ledger", "max_workers", "replicas",
+                    "batch_window_ms", "batch_max_refs",
+                    "slo_latency_p95_s", "slo_error_budget",
+                    "slo_burn_threshold", "slo_interval_s",
+                    "debug_bundle_dir", "regress_bench",
+                    "ledger_gc_interval_s", "ledger_max_rows",
+                )
+            },
         )
         print(
-            f"serve: live metrics on "
-            f"http://{server.host}:{server.port}/metrics",
+            "serve: flight recorder on, post-mortem bundles under "
+            f"{args.debug_bundle_dir}",
             file=sys.stderr,
         )
+        # SIGUSR2 = dump a bundle NOW, the kill(1)-reachable twin of
+        # the dump_debug request type. Registration only works on the
+        # main thread — embedders calling main() elsewhere just lose
+        # the signal hook, never the recorder.
+        import signal
+
+        if hasattr(signal, "SIGUSR2"):
+            try:
+                prev_usr2 = signal.signal(
+                    signal.SIGUSR2,
+                    lambda signum, frame: recorder.dump(
+                        "signal", trigger={"signal": "SIGUSR2"}
+                    ),
+                )
+            except ValueError:
+                prev_usr2 = None
     try:
         with AnalysisService(
             cache_dir=args.cache_dir, max_workers=args.max_workers,
@@ -797,6 +897,30 @@ def _serve(args) -> int:
             batch_max_refs=args.batch_max_refs,
             replicas=args.replicas,
         ) as svc:
+            if recorder is not None:
+                # live serving state for bundles: replica/mesh view +
+                # executor counters at dump time
+                recorder.state_provider = lambda: {
+                    "healthz": svc.healthz(),
+                    "executor": svc.executor.stats(),
+                }
+            if args.metrics_port is not None:
+                server = obs_metrics.MetricsServer(
+                    registry, port=args.metrics_port,
+                    healthz=svc.healthz, stats=svc.stats,
+                    bundles=(
+                        (lambda: {
+                            "bundle_dir": recorder.bundle_dir,
+                            "recorder": recorder.stats(),
+                            "bundles": recorder.bundle_index(),
+                        }) if recorder is not None else None
+                    ),
+                )
+                print(
+                    f"serve: live metrics on "
+                    f"http://{server.host}:{server.port}/metrics",
+                    file=sys.stderr,
+                )
             if args.warmup_from_ledger:
                 warmed = svc.warm_from_ledger(args.warmup_from_ledger)
                 print(
@@ -804,6 +928,12 @@ def _serve(args) -> int:
                     "from the ledger",
                     file=sys.stderr,
                 )
+            if args.ledger_gc_interval_s is not None:
+                gc = obs_ledger.LedgerGC(
+                    args.ledger,
+                    interval_s=args.ledger_gc_interval_s,
+                    max_rows=args.ledger_max_rows,
+                ).start()
             if (args.slo_latency_p95_s is not None
                     or args.slo_error_budget is not None):
                 from .config import SLOConfig
@@ -814,10 +944,17 @@ def _serve(args) -> int:
                     kw["latency_p95_s"] = args.slo_latency_p95_s
                 if args.slo_error_budget is not None:
                     kw["error_budget"] = args.slo_error_budget
+                import glob as glob_mod
+
+                bench_paths = (
+                    sorted(glob_mod.glob(args.regress_bench))
+                    if args.regress_bench else None
+                )
                 sentinel = obs_slo.SLOSentinel(
                     SLOConfig(**kw), registry=registry,
                     ledger_path=args.ledger,
                     interval_s=args.slo_interval_s,
+                    regress_bench=bench_paths,
                 ).start()
                 svc.slo_sentinel = sentinel
             failures = serve_jsonl(svc, fin, fout)
@@ -831,11 +968,29 @@ def _serve(args) -> int:
 
                     for line in obs_slo.format_report(report):
                         print(f"serve: {line}", file=sys.stderr)
+            if gc is not None:
+                # final compaction so the bound holds for whoever
+                # reads the ledger after this process exits
+                try:
+                    gc.run_once()
+                except Exception:
+                    pass
     finally:
+        if gc is not None:
+            gc.close()
         if sentinel is not None:
             sentinel.close()
         if server is not None:
             server.close()
+        if recorder is not None:
+            obs_recorder.disable()
+            if prev_usr2 is not None:
+                import signal
+
+                try:
+                    signal.signal(signal.SIGUSR2, prev_usr2)
+                except ValueError:
+                    pass
         obs_metrics.disable()
         if fin is not sys.stdin:
             fin.close()

@@ -26,6 +26,19 @@ once its JSON is written):
   ledger tail (latency p95, error/degradation budget, drift status,
   batch occupancy), emitting `slo_breach` events and gated offline by
   tools/check_slo.py.
+- **recorder** — the flight recorder: a bounded ring of per-request
+  records with tail-based retention (errors, degradations, drift
+  breaches, latency outliers kept; the boring majority dropped), fed
+  by the executor and the telemetry event sink, dumping atomic
+  schema-versioned post-mortem bundles on anomaly triggers (SLO
+  breach, request failure, replica quarantine, drift breach,
+  perf regression, explicit dump_debug / SIGUSR2); validated offline
+  by tools/check_bundle.py.
+- **regress** — the performance regression sentinel: per-engine /
+  per-stage latency and compile-count distributions across ledger
+  history plus the BENCH_r*.json headline trajectory, flagged beyond
+  a noise band; gated offline by tools/check_regression.py and
+  evaluated live by the serve-mode SLO sentinel.
 
 Everything here is observation only: with no ledger path and no export
 flag nothing in this package executes, and engine results are pinned
@@ -33,6 +46,11 @@ bit-identical with observability enabled vs disabled
 (tests/test_obs.py, tests/test_live_obs.py).
 """
 
-from . import drift, exporters, ledger, metrics, slo
+from . import (
+    drift, exporters, ledger, metrics, recorder, regress, slo,
+)
 
-__all__ = ["drift", "exporters", "ledger", "metrics", "slo"]
+__all__ = [
+    "drift", "exporters", "ledger", "metrics", "recorder",
+    "regress", "slo",
+]

@@ -46,9 +46,10 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 import time
 
-from ..io import append_text_line
+from ..io import append_text_line, atomic_write_text
 
 # v2 (current): request rows gain the trace context (`trace_id`
 # joining the row to its — possibly shared — execution span via
@@ -250,6 +251,129 @@ def tail(path: str, n: int = 5) -> list[dict]:
     except OSError:
         return []
     return rows[-n:] if n > 0 else []
+
+
+# -- scan / compaction (tools/check_ledger.py + serve-mode GC) ---------
+
+
+def scan(path: str, max_age_days: float = 0.0,
+         max_rows: int = 0, now: float | None = None) -> dict:
+    """Classify every ledger line for compaction.
+
+    Returns {"valid": [rows...], "invalid": [(line_no, error)],
+    "stale": [rows...], "surplus": [rows...]} — stale (older than
+    max_age_days, 0 = no age limit) and surplus (beyond the newest
+    max_rows, 0 = unbounded) rows are valid rows that `compact` would
+    drop. Single source of truth shared by tools/check_ledger.py and
+    the serve-mode background GC.
+    """
+    out: dict = {"valid": [], "invalid": [], "stale": [], "surplus": []}
+    if now is None:
+        now = time.time()
+    max_age_s = max_age_days * 86400.0
+    fresh: list = []
+    for line_no, row, error in iter_rows(path):
+        if row is None:
+            out["invalid"].append((line_no, error))
+            continue
+        if max_age_s > 0 and (now - float(row["ts"])) > max_age_s:
+            out["stale"].append(row)
+            continue
+        fresh.append(row)
+    if max_rows > 0 and len(fresh) > max_rows:
+        out["surplus"] = fresh[: len(fresh) - max_rows]
+        fresh = fresh[len(fresh) - max_rows:]
+    out["valid"] = fresh
+    return out
+
+
+def compact(path: str, max_age_days: float = 0.0,
+            max_rows: int = 0) -> dict:
+    """Atomically rewrite the ledger keeping only valid, fresh rows.
+
+    The scan classifies; when anything would be dropped, the kept rows
+    are rewritten via atomic_write_text (tmp + fsync + rename), so a
+    reader — or a concurrent appender racing the rename — always sees
+    a complete file. Returns the scan dict with a "dropped" count
+    added (0 = the file was already clean and was left untouched).
+    """
+    s = scan(path, max_age_days=max_age_days, max_rows=max_rows)
+    dropped = (
+        len(s["invalid"]) + len(s["stale"]) + len(s["surplus"])
+    )
+    if dropped:
+        atomic_write_text(path, "".join(
+            json.dumps(row, sort_keys=True, separators=(",", ":"))
+            + "\n"
+            for row in s["valid"]
+        ))
+    s["dropped"] = dropped
+    return s
+
+
+class LedgerGC:
+    """Serve-mode background ledger compaction on a fixed interval.
+
+    Soak runs append one row per request; without a bound the ledger
+    grows past what `tail`/`aggregate` readers can usefully scan. This
+    thread runs `compact(path, max_age_days, max_rows)` every
+    `interval_s` seconds, counting each pass into telemetry (and so
+    the live registry): `ledger_gc_runs`, and `ledger_gc_dropped` when
+    rows were actually removed. A failing pass counts
+    `ledger_gc_failed` and never takes the serving loop down.
+    """
+
+    def __init__(self, path: str, interval_s: float = 60.0,
+                 max_rows: int = 0, max_age_days: float = 0.0):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.path = path
+        self.interval_s = float(interval_s)
+        self.max_rows = int(max_rows)
+        self.max_age_days = float(max_age_days)
+        self.last_scan: dict | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def run_once(self) -> dict:
+        """One compaction pass (also the final flush on close)."""
+        from .. import telemetry
+
+        s = compact(self.path, max_age_days=self.max_age_days,
+                    max_rows=self.max_rows)
+        self.last_scan = s
+        telemetry.count("ledger_gc_runs")
+        if s["dropped"]:
+            telemetry.count("ledger_gc_dropped", s["dropped"])
+            telemetry.event(
+                "ledger_gc", path=self.path, dropped=s["dropped"],
+                kept=len(s["valid"]),
+            )
+        return s
+
+    def _loop(self) -> None:
+        from .. import telemetry
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                telemetry.count("ledger_gc_failed")
+
+    def start(self) -> "LedgerGC":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="pluss-ledger-gc", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 # -- aggregation (the CLI `stats` mode) --------------------------------

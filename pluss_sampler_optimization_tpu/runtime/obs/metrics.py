@@ -39,6 +39,7 @@ The scrape side lives here too: `MetricsServer` is a stdlib
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 
@@ -456,32 +457,66 @@ def observe(name: str, value: float, exemplar=None) -> None:
 
 
 class MetricsServer:
-    """Background stdlib HTTP server exposing `GET /metrics` in
-    Prometheus text format. `port=0` binds an ephemeral port (read it
-    back from `.port`). Serves 404 elsewhere and never raises into the
-    serving thread."""
+    """Background stdlib HTTP server for external probes.
+
+    Routes: `GET /metrics` (and `/`) always serve the registry in
+    Prometheus text format; when the optional `healthz` / `stats` /
+    `bundles` callables are wired (the serve-mode CLI passes the
+    AnalysisService's introspection methods and the flight recorder's
+    bundle index), `GET /healthz`, `GET /stats`, and
+    `GET /debug/bundles` serve their JSON — the same bodies the JSONL
+    control requests answer with, so liveness probes and dashboards
+    don't need to speak the serving protocol. `/healthz` stays
+    answerable even without a service callable (plain liveness of the
+    scrape server itself). `port=0` binds an ephemeral port (read it
+    back from `.port`). Serves 404 elsewhere and never raises into
+    the serving thread."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
-                 host: str = "127.0.0.1", prefix: str = "pluss_"):
+                 host: str = "127.0.0.1", prefix: str = "pluss_",
+                 healthz=None, stats=None, bundles=None):
         import http.server
 
         reg = registry
 
+        def _json_route(path: str):
+            """The JSON payload for `path`, or None for no route."""
+            if path == "/healthz":
+                return healthz() if healthz is not None else {
+                    "status": "ok", "service": False,
+                }
+            if path == "/stats" and stats is not None:
+                return stats()
+            if path == "/debug/bundles" and bundles is not None:
+                return bundles()
+            return None
+
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
-                try:
-                    body = reg.prometheus_text(prefix=prefix).encode()
-                except Exception as e:  # pragma: no cover - defensive
-                    self.send_error(500, repr(e))
-                    return
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    try:
+                        body = reg.prometheus_text(
+                            prefix=prefix
+                        ).encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    except Exception as e:  # pragma: no cover
+                        self.send_error(500, repr(e))
+                        return
+                else:
+                    try:
+                        payload = _json_route(path)
+                    except Exception as e:  # pragma: no cover
+                        self.send_error(500, repr(e))
+                        return
+                    if payload is None:
+                        self.send_error(404)
+                        return
+                    body = (json.dumps(payload) + "\n").encode()
+                    ctype = "application/json"
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type",
-                    "text/plain; version=0.0.4; charset=utf-8",
-                )
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

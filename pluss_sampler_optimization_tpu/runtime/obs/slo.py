@@ -242,13 +242,21 @@ class SLOSentinel:
 
     def __init__(self, config: SLOConfig = DEFAULT_SLO, registry=None,
                  ledger_path: str | None = None,
-                 interval_s: float = 10.0, tail_rows: int = 512):
+                 interval_s: float = 10.0, tail_rows: int = 512,
+                 regress_bench: list[str] | None = None,
+                 regress_noise_band: float | None = None):
         self.config = config
         self.registry = registry
         self.ledger_path = ledger_path
         self.interval_s = float(interval_s)
         self.tail_rows = int(tail_rows)
+        # Perf-regression leg (runtime/obs/regress.py): evaluated on
+        # the same tick over the same ledger tail, plus any BENCH_r*
+        # evidence files handed in. None band = module default.
+        self.regress_bench = list(regress_bench or [])
+        self.regress_noise_band = regress_noise_band
         self.last_report: dict | None = None
+        self.last_regression: dict | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -271,7 +279,36 @@ class SLOSentinel:
                     **{f"burn_{lbl}": b for lbl, b in burn.items()
                        if b is not None},
                 )
+        self._evaluate_regression(rows)
         return report
+
+    def _evaluate_regression(self, rows) -> None:
+        """The perf-regression leg of the tick: ledger-tail + bench
+        trajectory through regress.evaluate(). A breach counts
+        `perf_regression` into the live registry and the event reaches
+        the flight recorder's bundle trigger via the record sink; a
+        broken evaluation only counts — neither takes serving down."""
+        if rows is None and not self.regress_bench:
+            return
+        from . import regress
+
+        try:
+            kwargs = {}
+            if self.regress_noise_band is not None:
+                kwargs["noise_band"] = self.regress_noise_band
+            rep = regress.evaluate(
+                rows=rows, bench_paths=self.regress_bench, **kwargs
+            )
+        except Exception:
+            telemetry.count("regress_eval_failed")
+            return
+        self.last_regression = rep
+        if not rep["ok"]:
+            telemetry.count("perf_regression")
+            telemetry.event(
+                "perf_regression",
+                regressed=[c["check"] for c in rep["regressed"]],
+            )
 
     def start(self) -> "SLOSentinel":
         if self._thread is not None:

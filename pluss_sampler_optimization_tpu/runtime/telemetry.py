@@ -96,6 +96,28 @@ def metrics_sink():
     return _metrics_sink
 
 
+# Flight-recorder sink (runtime/obs/recorder.py) — the second leg of
+# the sink path: where _metrics_sink mirrors numeric count()/gauge()
+# writes, _record_sink mirrors event() emissions, so anomaly events
+# (slo_breach, replica_quarantined, drift_breach, ...) reach the
+# recorder's trigger logic without every emit site knowing about it.
+# Same discipline as the metrics sink: bare global, one None check on
+# the disabled path, and a failing sink never takes the caller down.
+_record_sink = None
+
+
+def set_record_sink(sink) -> None:
+    """Install (or with None, remove) the flight-recorder sink. Called
+    by runtime.obs.recorder.enable()/disable(); the sink needs
+    `record_event(name, data)`."""
+    global _record_sink
+    _record_sink = sink
+
+
+def record_sink():
+    return _record_sink
+
+
 class _NullSpan:
     """Shared no-op span: the entire disabled-telemetry hot path."""
 
@@ -390,6 +412,15 @@ def event(name: str, **data) -> None:
     tele = _current
     if tele is not None:
         tele.event(name, **data)
+    sink = _record_sink
+    if sink is not None:
+        # Observation must never sink the observed: a recorder bug
+        # (full disk under the bundle dir, a bad state provider) is
+        # its own problem, not the serving request's.
+        try:
+            sink.record_event(name, dict(data))
+        except Exception:
+            pass
 
 
 def record_fetch(host_tree):

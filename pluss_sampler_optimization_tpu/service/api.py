@@ -418,6 +418,27 @@ class AnalysisService:
             out["slo"] = self.slo_sentinel.last_report
         return out
 
+    def dump_debug(self) -> dict:
+        """Explicit post-mortem dump (the `dump_debug` request type):
+        ask the flight recorder (runtime/obs/recorder.py) to write one
+        bundle NOW, bypassing the trigger rate limit, and return its
+        path plus the recorder's state and bundle index. `enabled:
+        false` when no recorder is installed (serve mode without
+        --debug-bundle-dir)."""
+        from ..runtime.obs import recorder as obs_recorder
+
+        rec = obs_recorder.get()
+        if rec is None:
+            return {"enabled": False}
+        path = rec.dump("dump_debug")
+        return {
+            "enabled": True,
+            "bundle": path,
+            "bundle_dir": rec.bundle_dir,
+            "recorder": rec.stats(),
+            "bundles": rec.bundle_index(),
+        }
+
     def _run_preflight(self, request: AnalysisRequest,
                        program: Program) -> dict:
         """The static-analysis gate, run before fingerprint/cache.
@@ -537,7 +558,14 @@ class AnalysisService:
         self.close()
 
 
-CONTROL_TYPES = ("healthz", "stats", "metrics")
+CONTROL_TYPES = ("healthz", "stats", "metrics", "dump_debug")
+
+# Control types answered in the RESPONSE pass (after every request
+# line above them has been awaited) instead of as the line is read:
+# `metrics` so its live-histogram snapshot is deterministic within a
+# batch, `dump_debug` so the bundle's ring records include every
+# request the batch completed before the dump line.
+_DEFERRED_CONTROL_TYPES = ("metrics", "dump_debug")
 
 
 def parse_request_line(line: str) -> AnalysisRequest:
@@ -575,9 +603,10 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     (`ok: false`, `line`, `error`) with the request `id` echoed
     whenever the line parsed far enough to carry one. `healthz` /
     `stats` lines (CONTROL_TYPES) answer inline from the service's
-    introspection snapshot taken as the line is read; `metrics` lines
-    snapshot at response time instead, after every request line above
-    them has been awaited, so the live histograms they report are
+    introspection snapshot taken as the line is read; `metrics` and
+    `dump_debug` lines evaluate at response time instead, after every
+    request line above them has been awaited, so the live histograms
+    (and the post-mortem bundle's ring records) they report are
     deterministic within a batch.
     """
     # each entry: {"line", "id", and one of "ticket"+"request" |
@@ -606,11 +635,12 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
                     f"(have {', '.join(CONTROL_TYPES)})"
                 )
                 continue
-            if kind == "metrics":
+            if kind in _DEFERRED_CONTROL_TYPES:
                 # deferred to the response pass: every request line
-                # ABOVE this one has been awaited by then, so the
-                # live snapshot deterministically includes their
-                # stage histograms (read-time snapshots would race
+                # ABOVE this one has been awaited by then, so a
+                # metrics snapshot deterministically includes their
+                # stage histograms and a dump_debug bundle includes
+                # their ring records (read-time evaluation would race
                 # with worker completion)
                 entry["control"] = {"type": kind, "payload": None}
                 continue
@@ -639,9 +669,13 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     for entry in entries:
         if "control" in entry:
             payload = entry["control"]["payload"]
-            if entry["control"]["type"] == "metrics":
+            kind = entry["control"]["type"]
+            if kind in _DEFERRED_CONTROL_TYPES:
                 try:
-                    payload = service.metrics()
+                    payload = (
+                        service.metrics() if kind == "metrics"
+                        else service.dump_debug()
+                    )
                 except Exception as e:
                     payload = {"enabled": False,
                                "error": f"introspection failed: {e!r}"}

@@ -756,6 +756,7 @@ class RequestExecutor:
         }
         self._observe_stages(outcome, queue_s=queue_s,
                              execute_s=execute_s, fetch_s=fetch_s)
+        self._record_flight(request, outcome)
         self._note_latency(outcome, batched=False)
         if self.ledger_path:
             self._append_ledger_row(
@@ -783,6 +784,47 @@ class RequestExecutor:
         ):
             if value is not None:
                 obs_metrics.observe(name, value, exemplar=ex)
+
+    def _record_flight(self, request, outcome: dict,
+                       extra: dict | None = None) -> None:
+        """Feed one per-request record into the flight recorder
+        (runtime/obs/recorder.py); no-op when disabled. The record is
+        the outcome minus the payload-heavy `record` field, plus the
+        request identity — what a post-mortem needs to reconstruct the
+        request's path without shipping MRC arrays into every bundle.
+        A failed request fires the recorder's request_failure trigger
+        from inside record()."""
+        from ..runtime.obs import recorder as obs_recorder
+
+        if obs_recorder.get() is None:
+            return
+        rec = {
+            "trace_id": outcome.get("trace_id"),
+            "span_id": outcome.get("span_id"),
+            "model": request.model,
+            "n": request.n,
+            "engine_requested": request.engine,
+            "engine_used": (
+                outcome["record"].get("engine_used")
+                if outcome.get("record") else None
+            ),
+            "ok": outcome.get("record") is not None,
+            "error": outcome.get("error"),
+            "cache": outcome.get("cache"),
+            "degraded": outcome.get("degraded"),
+            "latency_s": outcome.get("latency_s"),
+            "queue_s": outcome.get("queue_s"),
+            "batch_wait_s": outcome.get("batch_wait_s"),
+            "execute_s": outcome.get("execute_s"),
+            "replica_id": outcome.get("replica_id"),
+            "mrc_digest": outcome.get("mrc_digest"),
+        }
+        pf = outcome.get("preflight")
+        if isinstance(pf, dict) and pf.get("verdict"):
+            rec["preflight"] = pf["verdict"]
+        if extra:
+            rec.update(extra)
+        obs_recorder.record(rec)
 
     # -- batched worker -----------------------------------------------
 
@@ -1047,6 +1089,13 @@ class RequestExecutor:
         """Ledger + future resolution for one batch member."""
         if e.preflight is not None:
             outcome.setdefault("preflight", e.preflight)
+        self._record_flight(
+            e.request, outcome,
+            extra=(
+                {"batch_id": batch_id, "batch_members": batch_members}
+                if batch_id is not None else None
+            ),
+        )
         if self.ledger_path:
             extra = {}
             if batch_id is not None:

@@ -271,6 +271,16 @@ def test_bench_emits_json_line(tmp_path):
     assert ip["models"]["bicg"]["verdict"] == "race"
     assert ip["models"]["bicg"]["races"] == 3
     assert ip["total_wall_ms"] > 0
+    # flight-recorder evidence: the on-vs-off overhead measurement ran
+    # and a clean engine run wrote no spurious bundles (the budget
+    # verdict itself lives in the evidence — wall-clock ratios at
+    # n=64 are too noisy to gate a test on)
+    fr = doc["extra"]["flight_recorder"]
+    assert "error" not in fr, fr
+    ro = fr["recorder_overhead"]
+    assert ro["disabled_s"] > 0 and ro["enabled_s"] > 0
+    assert ro["budget_pct"] == 2.0
+    assert ro["bundles_written"] == 0
     assert doc["unit"] == "samples/s/chip"
     assert doc["value"] == final["value"]
     assert doc["vs_baseline"] > 0  # native baseline must have run

@@ -1,0 +1,701 @@
+"""Flight recorder: anomaly-triggered post-mortem bundles, tail-based
+trace retention, and the ledger-driven performance regression sentinel
+(runtime/obs/recorder.py, runtime/obs/regress.py, the serve wiring,
+and the tools/check_bundle.py / check_regression.py gates).
+
+The ISSUE-12 acceptance invariants are pinned here: each of the five
+trigger paths — SLO breach, request failure, replica quarantine, drift
+breach, and explicit `dump_debug` — produces exactly one atomic,
+schema-valid bundle containing the retained span trees and a registry
+snapshot; tail-based retention keeps error/outlier records and evicts
+the boring majority under ring pressure; `check_regression` exits
+nonzero on an injected latency regression and clean over the repo's
+real BENCH_r*.json history; serve-mode ledger GC compacts in place;
+the scrape server answers /healthz, /stats, and /debug/bundles; and
+MRC bytes are bit-identical with the recorder on vs off.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import glob
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.config import SLOConfig
+from pluss_sampler_optimization_tpu.models import REGISTRY
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
+from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
+from pluss_sampler_optimization_tpu.runtime.obs import (
+    drift as obs_drift,
+    ledger as obs_ledger,
+    metrics as obs_metrics,
+    recorder as obs_recorder,
+    regress as obs_regress,
+    slo as obs_slo,
+)
+from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisRequest,
+    AnalysisService,
+    serve_jsonl,
+)
+from pluss_sampler_optimization_tpu.service.executor import (
+    default_runner,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+import check_bundle  # noqa: E402
+import check_regression  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    telemetry.disable()
+    obs_metrics.disable()
+    obs_recorder.disable()
+    yield
+    telemetry.disable()
+    obs_metrics.disable()
+    obs_recorder.disable()
+
+
+def _req(**kw):
+    base = dict(model="gemm", n=16, engine="oracle")
+    base.update(kw)
+    return AnalysisRequest(**base)
+
+
+def _bundles(bundle_dir):
+    """BUNDLE_*.json names in the dir, sorted (oldest first by the
+    timestamp+seq embedded in the name)."""
+    return sorted(
+        n for n in os.listdir(bundle_dir)
+        if n.startswith("BUNDLE_") and n.endswith(".json")
+    )
+
+
+def _load_bundle(bundle_dir, name):
+    with open(os.path.join(bundle_dir, name)) as f:
+        return json.load(f)
+
+
+def _flaky_runner(fail_times: int):
+    state = {"left": fail_times}
+    lock = threading.Lock()
+
+    def runner(engine, program, machine, request):
+        with lock:
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise RuntimeError("injected replica fault")
+        return default_runner(engine, program, machine, request)
+
+    return runner
+
+
+# -- ring mechanics / tail retention ----------------------------------
+
+
+def test_span_tree_synthesis():
+    rec = {
+        "trace_id": "t1", "span_id": "s1", "engine_used": "sampled",
+        "cache": "miss", "latency_s": 0.4, "queue_s": 0.1,
+        "execute_s": 0.25, "batch_wait_s": None,
+    }
+    tree = obs_recorder._span_tree(rec)
+    assert tree["name"] == "request" and tree["wall_s"] == 0.4
+    assert tree["attrs"]["trace_id"] == "t1"
+    # null stages are skipped; present ones nest in pipeline order
+    # with cumulative offsets
+    names = [c["name"] for c in tree["children"]]
+    assert names == ["queue", "execute"]
+    assert tree["children"][0]["start_s"] == 0.0
+    assert tree["children"][1]["start_s"] == pytest.approx(0.1)
+    assert tree["children"][1]["wall_s"] == 0.25
+    # no timings at all still yields a valid (empty) tree
+    bare = obs_recorder._span_tree({})
+    assert bare["wall_s"] == 0.0 and bare["children"] == []
+
+
+def test_tail_retention_keeps_interesting_evicts_boring(tmp_path):
+    """The tentpole retention invariant: under ring pressure the
+    error and latency-outlier records survive in the keep set while
+    the boring majority is dropped."""
+    tele = telemetry.enable()
+    rec = obs_recorder.FlightRecorder(
+        str(tmp_path / "bundles"), capacity=8, retain_capacity=4,
+        outlier_min_count=20,
+    )
+    for i in range(30):
+        rec.record_request({
+            "trace_id": f"ok{i}", "ok": True, "latency_s": 0.01,
+        })
+    # outlier: far above the windowed p99 of the 0.01s majority
+    rec.record_request({"trace_id": "slow", "ok": True,
+                        "latency_s": 5.0})
+    rec.record_request({"trace_id": "bad", "ok": False,
+                        "error": "boom", "latency_s": 0.01})
+    # push both out of the ring with more boring traffic
+    for i in range(20):
+        rec.record_request({
+            "trace_id": f"tail{i}", "ok": True, "latency_s": 0.01,
+        })
+    st = rec.stats()
+    assert st["records_seen"] == 52
+    assert st["ring"] == 8
+    assert st["evicted"] > 0
+    kept = {(r["trace_id"], r["retained"]) for r in rec._retained}
+    assert kept == {("slow", "latency_outlier"), ("bad", "error")}
+    # the failure also fired the request_failure trigger: one bundle
+    assert st["triggers"] == {"request_failure": 1}
+    assert len(_bundles(str(tmp_path / "bundles"))) == 1
+    assert tele.counters["recorder_records"] == 52
+    telemetry.disable()
+
+
+def test_event_records_and_retention_classes(tmp_path):
+    rec = obs_recorder.FlightRecorder(
+        str(tmp_path / "b"), capacity=2, retain_capacity=4,
+        min_interval_s=0.0,
+    )
+    # routine events ride the ring and age out; anomaly events retain
+    rec.record_event("ledger_gc", {"dropped": 3})
+    rec.record_event("export_failed", {"path": "x"})
+    rec.record_event("ledger_gc", {"dropped": 1})
+    rec.record_event("ledger_gc", {"dropped": 2})
+    names = {(r["name"], r["retained"]) for r in rec._retained}
+    assert ("export_failed", "event") in names
+    assert not any(n == "ledger_gc" for n, _c in names)
+    # trigger events write a bundle named for their reason
+    rec.record_event("drift_breach", {"model": "gemm", "n": 16})
+    files = _bundles(str(tmp_path / "b"))
+    assert len(files) == 1 and files[0].endswith("_drift_breach.json")
+
+
+def test_rate_limit_one_bundle_per_reason_window(tmp_path):
+    rec = obs_recorder.FlightRecorder(
+        str(tmp_path / "b"), min_interval_s=3600.0,
+    )
+    assert rec.trigger("slo_breach", {"check": "x"}) is not None
+    assert rec.trigger("slo_breach", {"check": "x"}) is None
+    # a DIFFERENT reason is not suppressed by slo_breach's window
+    assert rec.trigger("drift_breach", {}) is not None
+    # force (the dump_debug / SIGUSR2 path) bypasses the limit
+    assert rec.dump("dump_debug") is not None
+    assert rec.dump("dump_debug") is not None
+    st = rec.stats()
+    assert st["bundles_suppressed"] == 1
+    assert st["bundles_written"] == 4
+    assert len(_bundles(str(tmp_path / "b"))) == 4
+
+
+# -- bundle schema ----------------------------------------------------
+
+
+def test_validate_bundle_schema_violations(tmp_path):
+    rec = obs_recorder.FlightRecorder(str(tmp_path / "b"))
+    rec.record_request({"trace_id": "t", "ok": True,
+                        "latency_s": 0.01})
+    path = rec.dump("dump_debug", trigger={"who": "test"})
+    doc = json.load(open(path))
+    assert obs_recorder.validate_bundle(doc) == []
+    assert doc["reason"] == "dump_debug"
+    assert doc["trigger"] == {"who": "test"}
+    assert doc["records"][0]["span_tree"]["name"] == "request"
+    assert isinstance(doc["host"], dict)
+    assert isinstance(doc["compile_counters"], dict)
+
+    assert obs_recorder.validate_bundle([]) \
+        == ["bundle is not a JSON object"]
+    bad = dict(doc, bundle_version=99)
+    assert any("bundle_version" in e
+               for e in obs_recorder.validate_bundle(bad))
+    bad = dict(doc, reason="nope")
+    assert any("'reason'" in e
+               for e in obs_recorder.validate_bundle(bad))
+    bad = dict(doc, records=[{"kind": "weird"}])
+    errs = obs_recorder.validate_bundle(bad)
+    assert any("records[0].kind" in e for e in errs)
+    assert any("records[0].ts" in e for e in errs)
+    bad = dict(doc, records=[dict(doc["records"][0],
+                                  retained="whatever")])
+    assert any("retained" in e
+               for e in obs_recorder.validate_bundle(bad))
+    bad = dict(doc)
+    del bad["ledger_tail"]
+    assert any("ledger_tail" in e
+               for e in obs_recorder.validate_bundle(bad))
+
+
+# -- the five trigger paths -------------------------------------------
+
+
+def test_request_failure_trigger_writes_one_valid_bundle(tmp_path):
+    def broken_runner(engine, program, machine, request):
+        raise RuntimeError("no dice")
+
+    bundle_dir = str(tmp_path / "bundles")
+    tele = telemetry.enable()
+    obs_recorder.enable(bundle_dir, ledger_path=None,
+                        config={"mode": "test"})
+    with AnalysisService(runner=broken_runner) as svc:
+        r1 = svc.result(svc.submit(_req()), timeout=300)
+        r2 = svc.result(svc.submit(_req(n=32)), timeout=300)
+    rec = obs_recorder.get()
+    stats = rec.stats()
+    telemetry.disable()
+
+    assert not r1.ok and "no dice" in r1.error
+    assert not r2.ok
+    # two failures inside one rate-limit window: exactly one bundle
+    files = _bundles(bundle_dir)
+    assert len(files) == 1 and files[0].endswith(
+        "_request_failure.json")
+    assert stats["triggers"] == {"request_failure": 1}
+    assert stats["bundles_suppressed"] == 1
+    assert tele.counters["debug_bundles_written"] == 1
+
+    doc = _load_bundle(bundle_dir, files[0])
+    assert obs_recorder.validate_bundle(doc) == []
+    assert doc["reason"] == "request_failure"
+    assert doc["config"] == {"mode": "test"}
+    assert "no dice" in doc["trigger"]["error"]
+    failed = [r for r in doc["records"]
+              if r["kind"] == "request" and not r["ok"]]
+    assert failed and failed[0]["span_tree"]["name"] == "request"
+    assert failed[0]["retained"] == "error"
+
+
+def test_slo_breach_trigger_via_record_sink(tmp_path):
+    """The sentinel's slo_breach event reaches the recorder through
+    telemetry.set_record_sink — the emit site knows nothing about
+    bundles."""
+    bundle_dir = str(tmp_path / "bundles")
+    reg = obs_metrics.enable()
+    telemetry.enable()
+    obs_recorder.enable(bundle_dir)
+    now = 5000.0
+    for _ in range(20):
+        reg.observe("request_total_s", 0.8, now=now)
+        reg.inc("service_submitted", now=now)
+    sentinel = obs_slo.SLOSentinel(
+        SLOConfig(latency_p95_s=0.1, error_budget=0.5), registry=reg,
+    )
+    report = sentinel.evaluate_once(now=now)
+    telemetry.disable()
+
+    assert report["ok"] is False
+    files = _bundles(bundle_dir)
+    assert len(files) == 1 and files[0].endswith("_slo_breach.json")
+    doc = _load_bundle(bundle_dir, files[0])
+    assert obs_recorder.validate_bundle(doc) == []
+    assert doc["trigger"]["event"] == "slo_breach"
+    assert doc["trigger"]["check"] == "latency_p95"
+    # the registry snapshot rides the bundle
+    assert doc["registry"]["histograms"]["request_total_s"]["count"] \
+        == 20
+
+
+def test_replica_quarantine_trigger(tmp_path):
+    bundle_dir = str(tmp_path / "bundles")
+    tele = telemetry.enable()
+    obs_recorder.enable(bundle_dir)
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"),
+        replicas=2, runner=_flaky_runner(1),
+    ) as svc:
+        resp = svc.result(svc.submit(_req(
+            engine="sampled", ratio=0.3, seed=1)), timeout=300)
+    telemetry.disable()
+
+    assert resp.ok and resp.degraded  # re-routed, not failed
+    assert tele.counters.get("replica_quarantined") == 1
+    files = _bundles(bundle_dir)
+    assert len(files) == 1 and files[0].endswith(
+        "_replica_quarantine.json")
+    doc = _load_bundle(bundle_dir, files[0])
+    assert obs_recorder.validate_bundle(doc) == []
+    assert doc["trigger"]["event"] == "replica_quarantined"
+
+
+def test_drift_breach_trigger(tmp_path):
+    bundle_dir = str(tmp_path / "bundles")
+    telemetry.enable()
+    obs_recorder.enable(bundle_dir)
+    # negative thresholds: any nonzero delta (even zero) breaches
+    row = obs_drift.drift_audit(
+        "gemm", n=16,
+        thresholds={"max_abs_delta": -1.0, "mean_abs_delta": -1.0},
+    )
+    telemetry.disable()
+
+    assert row["breach"]
+    files = _bundles(bundle_dir)
+    assert len(files) == 1 and files[0].endswith("_drift_breach.json")
+    doc = _load_bundle(bundle_dir, files[0])
+    assert obs_recorder.validate_bundle(doc) == []
+    assert doc["trigger"]["event"] == "drift_breach"
+    assert doc["trigger"]["model"] == "gemm"
+
+
+def test_perf_regression_trigger_from_sentinel(tmp_path):
+    """The regression leg of the sentinel tick: a ledger tail whose
+    recent half is 5x slower trips regress.evaluate, and the
+    perf_regression event lands a bundle."""
+    bundle_dir = str(tmp_path / "bundles")
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    _ledger_with_latencies(ledger_path, [0.01] * 10 + [0.05] * 10)
+    tele = telemetry.enable()
+    obs_recorder.enable(bundle_dir, ledger_path=ledger_path)
+    sentinel = obs_slo.SLOSentinel(
+        SLOConfig(), ledger_path=ledger_path,
+    )
+    sentinel.evaluate_once()
+    telemetry.disable()
+
+    assert sentinel.last_regression is not None
+    assert sentinel.last_regression["ok"] is False
+    assert tele.counters.get("perf_regression") == 1
+    files = _bundles(bundle_dir)
+    assert len(files) == 1 and files[0].endswith(
+        "_perf_regression.json")
+    doc = _load_bundle(bundle_dir, files[0])
+    assert obs_recorder.validate_bundle(doc) == []
+    assert any("latency_p50_s" in c
+               for c in doc["trigger"]["regressed"])
+    # the recorder pulled the ledger tail into the bundle
+    assert len(doc["ledger_tail"]) == 20
+
+
+def test_serve_dump_debug_control_line(tmp_path):
+    """The explicit path: a dump_debug line in the serve stream is
+    answered in the response pass, so its bundle's ring records
+    include the request completed above it."""
+    bundle_dir = str(tmp_path / "bundles")
+    obs_recorder.enable(bundle_dir)
+    lines = [
+        json.dumps({"id": "r1", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "d", "type": "dump_debug"}),
+    ]
+    import io as io_mod
+
+    out = io_mod.StringIO()
+    with AnalysisService() as svc:
+        failures = serve_jsonl(
+            svc, io_mod.StringIO("\n".join(lines) + "\n"), out)
+    assert failures == 0
+    r1, d = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert r1["ok"]
+    payload = d["dump_debug"]
+    assert payload["enabled"] is True
+    assert os.path.isfile(payload["bundle"])
+    assert payload["bundle_dir"] == bundle_dir
+    assert payload["bundles"] and \
+        payload["bundles"][-1]["reason"] == "dump_debug"
+    doc = json.load(open(payload["bundle"]))
+    assert obs_recorder.validate_bundle(doc) == []
+    traces = [r.get("trace_id") for r in doc["records"]
+              if r["kind"] == "request"]
+    assert r1["trace_id"] in traces
+
+    # without a recorder the control line degrades, not errors
+    obs_recorder.disable()
+    out2 = io_mod.StringIO()
+    with AnalysisService() as svc:
+        serve_jsonl(
+            svc,
+            io_mod.StringIO(
+                json.dumps({"id": "d2", "type": "dump_debug"}) + "\n"
+            ),
+            out2,
+        )
+    d2 = json.loads(out2.getvalue())
+    assert d2["ok"] and d2["dump_debug"] == {"enabled": False}
+
+
+# -- bit-identity -----------------------------------------------------
+
+
+def test_mrc_bit_identical_with_recorder_enabled(tmp_path):
+    """The acceptance bit-identity check: the flight recorder is
+    observation-only — enabling it must not perturb engine numerics."""
+    prog = REGISTRY["gemm"](16)
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=3)
+
+    def mrc_bytes():
+        state, _ = run_sampled(prog, machine, cfg)
+        T = machine.thread_num
+        return aet_mrc(
+            cri_distribute(state, T, T), machine
+        ).tobytes()
+
+    off = mrc_bytes()
+    obs_recorder.enable(str(tmp_path / "bundles"))
+    on = mrc_bytes()
+    obs_recorder.disable()
+    assert on == off
+    assert np.frombuffer(off, dtype=np.float64).size > 0
+
+
+# -- scrape server JSON routes ----------------------------------------
+
+
+def _http_get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+def test_metrics_server_json_routes(tmp_path):
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("reqs", 2)
+    with obs_metrics.MetricsServer(
+        reg, port=0,
+        healthz=lambda: {"status": "ok", "service": True},
+        stats=lambda: {"executor": {"submitted": 2}},
+        bundles=lambda: {"bundle_dir": str(tmp_path), "bundles": []},
+    ) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+        status, ctype, body = _http_get(base + "/healthz")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body) == {"status": "ok", "service": True}
+        _status, _ctype, body = _http_get(base + "/stats")
+        assert json.loads(body)["executor"]["submitted"] == 2
+        _status, _ctype, body = _http_get(base + "/debug/bundles")
+        assert json.loads(body)["bundles"] == []
+        # Prometheus text still served on /metrics and /
+        _status, ctype, body = _http_get(base + "/metrics")
+        assert ctype.startswith("text/plain")
+        assert "pluss_reqs_total 2" in body
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _http_get(base + "/nope")
+        assert exc.value.code == 404
+
+    # bare server (no callables): /healthz answers liveness, the
+    # optional JSON routes 404
+    with obs_metrics.MetricsServer(reg, port=0) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+        _status, _ctype, body = _http_get(base + "/healthz")
+        assert json.loads(body) == {"status": "ok", "service": False}
+        for path in ("/stats", "/debug/bundles"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _http_get(base + path)
+            assert exc.value.code == 404
+
+
+# -- ledger GC --------------------------------------------------------
+
+
+def _ledger_with_latencies(path, latencies, ts=10_000.0):
+    for i, lat in enumerate(latencies):
+        obs_ledger.append(path, {
+            "ts": ts + i * 0.001, "kind": "request",
+            "source": "service", "ok": True,
+            "engine_requested": "sampled", "engine_used": "sampled",
+            "model": "gemm", "n": 16, "latency_s": lat,
+            "cache": "miss", "degraded": [], "fingerprint": None,
+            "mrc_digest": None,
+        })
+
+
+def test_ledger_scan_compact_and_gc(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    _ledger_with_latencies(path, [0.01] * 10)
+    with open(path, "a") as f:
+        f.write("not json at all\n")
+
+    s = obs_ledger.scan(path)
+    assert len(s["valid"]) == 10 and len(s["invalid"]) == 1
+
+    tele = telemetry.enable()
+    gc = obs_ledger.LedgerGC(path, interval_s=3600.0, max_rows=4)
+    s = gc.run_once()
+    telemetry.disable()
+    assert s["dropped"] == 7  # 1 invalid + 6 surplus
+    rows = obs_ledger.read_rows(path)
+    assert len(rows) == 4
+    # the newest rows survive
+    assert [r["ts"] for r in rows] == sorted(r["ts"] for r in rows)
+    assert rows[-1]["ts"] == pytest.approx(10_000.009)
+    assert tele.counters["ledger_gc_runs"] == 1
+    assert tele.counters["ledger_gc_dropped"] == 7
+    assert any(e["name"] == "ledger_gc" and e["dropped"] == 7
+               for e in tele.events)
+    # an already-clean ledger is left untouched
+    before = os.stat(path).st_mtime_ns
+    assert gc.run_once()["dropped"] == 0
+    assert os.stat(path).st_mtime_ns == before
+
+
+def test_ledger_gc_background_thread(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    _ledger_with_latencies(path, [0.01] * 6)
+    tele = telemetry.enable()
+    gc = obs_ledger.LedgerGC(path, interval_s=0.05, max_rows=3).start()
+    deadline = time.time() + 10
+    while (tele.counters.get("ledger_gc_runs", 0) < 2
+           and time.time() < deadline):
+        time.sleep(0.01)
+    gc.close()
+    telemetry.disable()
+    assert tele.counters.get("ledger_gc_runs", 0) >= 2
+    assert len(obs_ledger.read_rows(path)) == 3
+
+
+# -- offline gates ----------------------------------------------------
+
+
+def test_check_regression_clean_on_real_bench_history(capsys):
+    """Acceptance: the gate runs clean over the repo's own BENCH_r*
+    evidence trail."""
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    assert len(paths) >= 3
+    assert check_regression.main(["--bench"] + paths) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("regression: ok")
+    assert "bench:" in out
+
+
+def test_check_regression_trips_on_injected_regression(tmp_path,
+                                                       capsys):
+    path = str(tmp_path / "ledger.jsonl")
+    _ledger_with_latencies(path, [0.01] * 10 + [0.05] * 10)
+    assert check_regression.main(["--ledger", path]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    assert "ledger:sampled:latency_p50_s" in out
+
+    # a flat history inside the noise band passes
+    flat = str(tmp_path / "flat.jsonl")
+    _ledger_with_latencies(flat, [0.01] * 20)
+    assert check_regression.main(["--ledger", flat]) == 0
+    capsys.readouterr()
+
+    # too little history = nothing to regress against (vacuous pass)
+    thin = str(tmp_path / "thin.jsonl")
+    _ledger_with_latencies(thin, [0.01] * 4)
+    assert check_regression.main(["--ledger", thin]) == 0
+    out = capsys.readouterr().out
+    assert "insufficient history" in out
+
+    assert check_regression.main(
+        ["--ledger", str(tmp_path / "missing.jsonl")]) == 1
+    with pytest.raises(SystemExit):
+        check_regression.main([])  # nothing to check
+
+
+def test_check_bundle_gate(tmp_path, capsys):
+    bundle_dir = str(tmp_path / "bundles")
+    rec = obs_recorder.FlightRecorder(bundle_dir, min_interval_s=0.0)
+    rec.record_request({"trace_id": "t", "ok": True,
+                        "latency_s": 0.01})
+    first = rec.dump("dump_debug")
+    assert check_bundle.main([bundle_dir]) == 0
+    capsys.readouterr()
+
+    # corrupt file trips the gate; --gc removes it and goes green
+    corrupt = os.path.join(bundle_dir, "BUNDLE_corrupt.json")
+    with open(corrupt, "w") as f:
+        f.write("{broken")
+    assert check_bundle.main([bundle_dir]) == 1
+    assert "INVALID" in capsys.readouterr().err
+    assert check_bundle.main([bundle_dir, "--gc"]) == 0
+    capsys.readouterr()
+    assert not os.path.exists(corrupt)
+    assert check_bundle.main([bundle_dir]) == 0
+    capsys.readouterr()
+
+    # --max-bundles: the oldest becomes surplus once a newer exists
+    rec.dump("dump_debug")
+    assert check_bundle.main([bundle_dir, "--max-bundles", "1"]) == 1
+    capsys.readouterr()
+    assert check_bundle.main(
+        [bundle_dir, "--max-bundles", "1", "--gc"]) == 0
+    capsys.readouterr()
+    assert not os.path.exists(first)
+    assert len(_bundles(bundle_dir)) == 1
+
+    assert check_bundle.main([str(tmp_path / "nosuch")]) == 1
+    capsys.readouterr()
+
+
+# -- CLI surface ------------------------------------------------------
+
+
+def test_cli_rejects_recorder_flags_outside_serve(tmp_path):
+    base = ["acc", "--model", "gemm", "--n", "8", "--engine",
+            "oracle"]
+    with pytest.raises(SystemExit):
+        main(base + ["--debug-bundle-dir", str(tmp_path)])
+    with pytest.raises(SystemExit):
+        main(base + ["--regress-bench", "BENCH_r*.json"])
+    with pytest.raises(SystemExit):
+        main(base + ["--ledger-gc-interval-s", "60"])
+    # serve mode still needs --ledger for GC
+    with pytest.raises(SystemExit):
+        main(["serve", "--requests", "/dev/null",
+              "--ledger-gc-interval-s", "60"])
+
+
+def test_cli_serve_flight_recorder_end_to_end(tmp_path, capsys):
+    """serve --debug-bundle-dir: the recorder is announced, the
+    dump_debug control line writes a validated bundle carrying the
+    resolved config and the request's record, the ledger GC compacts
+    on exit, and the recorder is torn down."""
+    requests = tmp_path / "requests.jsonl"
+    requests.write_text("\n".join([
+        json.dumps({"id": "r1", "model": "gemm", "n": 16,
+                    "engine": "oracle"}),
+        json.dumps({"id": "d", "type": "dump_debug"}),
+    ]) + "\n")
+    responses = tmp_path / "responses.jsonl"
+    bundle_dir = tmp_path / "bundles"
+    ledger = tmp_path / "ledger.jsonl"
+    assert main([
+        "serve", "--requests", str(requests),
+        "--responses", str(responses),
+        "--cache-dir", str(tmp_path / "store"),
+        "--ledger", str(ledger),
+        "--debug-bundle-dir", str(bundle_dir),
+        "--ledger-gc-interval-s", "3600", "--ledger-max-rows", "100",
+    ]) == 0
+    err = capsys.readouterr().err
+    assert "serve: flight recorder on" in err
+
+    r1, d = [json.loads(ln)
+             for ln in responses.read_text().splitlines()]
+    assert r1["ok"] and r1["trace_id"]
+    payload = d["dump_debug"]
+    assert payload["enabled"] is True
+    doc = json.load(open(payload["bundle"]))
+    assert obs_recorder.validate_bundle(doc) == []
+    assert doc["config"]["debug_bundle_dir"] == str(bundle_dir)
+    assert doc["config"]["ledger_max_rows"] == 100
+    traces = [r.get("trace_id") for r in doc["records"]
+              if r["kind"] == "request"]
+    assert r1["trace_id"] in traces
+    # live serving state rode along via the state provider, and the
+    # always-on serve registry snapshot carries the stage histograms
+    assert doc["state"] and "healthz" in doc["state"]
+    assert doc["registry"]["histograms"]["request_total_s"]["count"] \
+        >= 1
+
+    # the bundle dir validates clean under the offline gate
+    assert check_bundle.main([str(bundle_dir)]) == 0
+    capsys.readouterr()
+    # serve tears the recorder down on exit
+    assert obs_recorder.get() is None
+    assert os.path.isfile(ledger)

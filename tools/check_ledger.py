@@ -28,10 +28,8 @@ batched-vs-solo latency joined on batch_id).
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
-import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,27 +41,13 @@ def scan_ledger(path: str, max_age_days: float = 0.0,
     """Classify every line. Returns {"valid": [rows...],
     "invalid": [(line_no, error)], "stale": [rows...],
     "surplus": [rows...]} — stale/surplus rows are valid rows that
-    --gc would drop."""
+    --gc would drop. Thin wrapper over ledger.scan, the shared
+    implementation the serve-mode background GC (ledger.LedgerGC)
+    also compacts through."""
     from pluss_sampler_optimization_tpu.runtime.obs import ledger
 
-    out: dict = {"valid": [], "invalid": [], "stale": [],
-                 "surplus": []}
-    now = time.time()
-    max_age_s = max_age_days * 86400.0
-    fresh: list = []
-    for line_no, row, error in ledger.iter_rows(path):
-        if row is None:
-            out["invalid"].append((line_no, error))
-            continue
-        if max_age_s > 0 and (now - float(row["ts"])) > max_age_s:
-            out["stale"].append(row)
-            continue
-        fresh.append(row)
-    if max_rows > 0 and len(fresh) > max_rows:
-        out["surplus"] = fresh[: len(fresh) - max_rows]
-        fresh = fresh[len(fresh) - max_rows:]
-    out["valid"] = fresh
-    return out
+    return ledger.scan(path, max_age_days=max_age_days,
+                       max_rows=max_rows)
 
 
 def main(argv=None) -> int:
@@ -112,16 +96,10 @@ def main(argv=None) -> int:
         + len(scan["surplus"])
     )
     if args.gc and n_bad:
-        from pluss_sampler_optimization_tpu.runtime.io import (
-            atomic_write_text,
-        )
+        from pluss_sampler_optimization_tpu.runtime.obs import ledger
 
-        text = "".join(
-            json.dumps(row, sort_keys=True, separators=(",", ":"))
-            + "\n"
-            for row in scan["valid"]
-        )
-        atomic_write_text(args.ledger, text)
+        ledger.compact(args.ledger, max_age_days=args.max_age_days,
+                       max_rows=args.max_rows)
 
     print(
         f"{args.ledger}: {len(scan['valid'])} valid, "
