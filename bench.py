@@ -1453,6 +1453,51 @@ def main() -> int:
         except Exception as e:  # never sink the headline metric
             ip["error"] = repr(e)
 
+    # Program-frontend (frontend/) serving claim: parsing an inline
+    # JSON document back into canonical IR must be noise next to the
+    # request it fronts — the evidence records the parse+preflight
+    # wall for the bench model's own dump as a fraction of the
+    # headline request latency, plus a short generative-fuzz sweep
+    # (the cheap contract: round-trip + exact-engine bit-identity +
+    # mutant rejection; the sampled sweep is tools/fuzz_ir.py's job).
+    if extras_budget_left("custom_frontend", extra):
+        cf: dict = {}
+        extra["custom_frontend"] = cf
+        try:
+            from pluss_sampler_optimization_tpu import analysis
+            from pluss_sampler_optimization_tpu.frontend import (
+                fuzz as frontend_fuzz,
+            )
+            from pluss_sampler_optimization_tpu.frontend import (
+                parse_program,
+                program_to_json,
+            )
+            from pluss_sampler_optimization_tpu.models import (
+                build as build_model,
+            )
+
+            doc = program_to_json(build_model(args.model, args.n))
+            # parse through JSON text, as a serve payload arrives
+            text = json.dumps(doc)
+            walls = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                parsed = parse_program(json.loads(text))
+                analysis.analyze_program(parsed, machine)
+                walls.append(time.perf_counter() - t0)
+            parse_ms = sorted(walls)[len(walls) // 2] * 1e3
+            cf["parse_preflight_ms"] = round(parse_ms, 3)
+            cf["headline_latency_s"] = round(t_tpu, 6)
+            cf["overhead_frac"] = round(parse_ms / 1e3 / t_tpu, 5)
+            sweep = frontend_fuzz.run_seeds(8, sampled=False)
+            cf["fuzz_seeds_passed"] = (
+                f"{sweep['passed']}/{sweep['seeds']}"
+            )
+            if sweep["failed"]:
+                cf["fuzz_failures"] = sweep["failures"]
+        except Exception as e:  # never sink the headline metric
+            cf["error"] = repr(e)
+
     if have_counters and "compile_cache" in extra:
         # final snapshot: the extras (periodic_exact, second model) may
         # have compiled too; "total" must mean the whole process

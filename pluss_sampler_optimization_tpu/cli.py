@@ -34,6 +34,70 @@ def _build_model(name: str, n: int, tsteps: int):
         raise SystemExit(str(e.args[0] if e.args else e))
 
 
+def _dump_ir(args) -> int:
+    """`--dump-ir MODEL` / `--dump-ir-dir DIR`: registry models as
+    frontend JSON documents — copy-paste templates for custom nests,
+    pinned (tests/test_frontend.py) to parse back fingerprint-
+    identical to the registry request."""
+    import json as _json
+    import os
+
+    from .frontend.schema import program_to_json
+    from .models import REGISTRY
+
+    if args.dump_ir:
+        prog = _build_model(args.dump_ir, args.n, args.tsteps)
+        print(_json.dumps(program_to_json(prog), indent=2))
+        return 0
+    os.makedirs(args.dump_ir_dir, exist_ok=True)
+    for name in sorted(REGISTRY):
+        try:
+            prog = _build_model(name, args.n, args.tsteps)
+        except SystemExit:
+            # models without a time axis reject --tsteps != 1; dump
+            # them at their only valid tsteps instead of skipping
+            prog = _build_model(name, args.n, 1)
+        path = os.path.join(args.dump_ir_dir, f"{name}.json")
+        with open(path, "w") as f:
+            _json.dump(program_to_json(prog), f, indent=2)
+            f.write("\n")
+        print(f"{name:<12} -> {path}")
+    return 0
+
+
+def _load_program_json(args, machine):
+    """Load + strictly parse a frontend document for --program-json.
+
+    Returns (program, machine-with-document-knobs) and rewrites
+    args.model/"_program_doc" so ledger rows say model:"custom" and
+    service-routed requests carry the document inline. Rejections
+    exit with the same diagnostics serve returns for the document."""
+    import json as _json
+
+    from .frontend.parse import parse_program_doc
+    from .frontend.schema import machine_from_doc
+
+    try:
+        with open(args.program_json) as f:
+            doc = _json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(
+            f"cannot read program JSON {args.program_json!r}: {e}"
+        )
+    res = parse_program_doc(doc)
+    if not res.ok:
+        lines = [f"{args.program_json}: frontend rejected program"]
+        lines += [
+            f"  [{d.severity}] {d.code} at {d.path or '/'}: "
+            f"{d.message}"
+            for d in res.errors()
+        ]
+        raise SystemExit("\n".join(lines))
+    args.model = "custom"
+    args._program_doc = doc
+    return res.program, machine_from_doc(doc, machine)
+
+
 def _list_models() -> int:
     """The 18-model registry with family/engine-audit status: which
     exact-router families are PROVEN bit-identical through the
@@ -184,6 +248,23 @@ def main(argv=None) -> int:
     ap.add_argument("--n", type=int, default=128)
     ap.add_argument("--tsteps", type=int, default=1,
                     help="time steps (jacobi-2d, fdtd-2d, heat-3d, adi)")
+    ap.add_argument("--dump-ir", default=None, metavar="MODEL",
+                    help="print MODEL's canonical IR as a frontend "
+                    "JSON document (at --n/--tsteps) and exit; the "
+                    "dump round-trips through --program-json / the "
+                    "serve 'program' field fingerprint-identically "
+                    "to the registry request")
+    ap.add_argument("--dump-ir-dir", default=None, metavar="DIR",
+                    help="write every registry model's frontend JSON "
+                    "to DIR/<model>.json (at --n) and exit")
+    ap.add_argument("--program-json", default=None, metavar="PATH",
+                    help="load the program from a frontend JSON "
+                    "document instead of the model registry "
+                    "(acc|speed|sample|analyze; overrides --model/"
+                    "--n/--tsteps; document machine knobs override "
+                    "--threads/--chunk). Rejections print the same "
+                    "machine-readable diagnostics the serve path "
+                    "returns")
     ap.add_argument(
         "--engine",
         default=None,
@@ -533,9 +614,20 @@ def main(argv=None) -> int:
 
     if args.list_models:
         return _list_models()
+    if args.dump_ir or args.dump_ir_dir:
+        # jax-free early exit like --list-models: dumping IR is pure
+        # models/ + frontend/schema.py
+        return _dump_ir(args)
     if args.mode is None:
         ap.error("mode is required (acc|speed|sample|trace|serve|"
                  "stats|analyze)")
+
+    if args.program_json and args.mode in ("serve", "trace", "stats"):
+        raise SystemExit(
+            "--program-json loads an inline frontend document for "
+            "acc|speed|sample|analyze; serve mode takes a 'program' "
+            "field per request line instead"
+        )
 
     if args.mode == "stats":
         return _stats(args)
@@ -617,7 +709,10 @@ def main(argv=None) -> int:
     from .config import MachineConfig
 
     machine = MachineConfig(thread_num=args.threads, chunk_size=args.chunk)
-    program = _build_model(args.model, args.n, args.tsteps)
+    if args.program_json:
+        program, machine = _load_program_json(args, machine)
+    else:
+        program = _build_model(args.model, args.n, args.tsteps)
     engine = args.engine or ("sampled" if args.mode == "sample" else "dense")
     if args.checkpoint_dir is not None and engine != "sampled":
         raise SystemExit(
@@ -763,7 +858,10 @@ def _analyze(args) -> int:
     machine = MachineConfig(
         thread_num=args.threads, chunk_size=args.chunk
     )
-    program = _build_model(args.model, args.n, args.tsteps)
+    if args.program_json:
+        program, machine = _load_program_json(args, machine)
+    else:
+        program = _build_model(args.model, args.n, args.tsteps)
     report = analysis.analyze_program(program, machine)
     if args.analysis_json:
         print(_json.dumps(report.to_dict(), indent=2, sort_keys=True))
@@ -824,6 +922,7 @@ def _request_from_args(args, engine):
         runtime=args.runtime, threads=args.threads, chunk=args.chunk,
         ratio=args.ratio, seed=args.seed, device_draw=args.device_draw,
         fuse_refs=args.fuse_refs, pipeline_depth=args.pipeline_depth,
+        program=getattr(args, "_program_doc", None),
         deadline_s=args.deadline_s,
     )
 

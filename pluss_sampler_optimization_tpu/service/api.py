@@ -20,7 +20,11 @@ request in input order. Request schema (README "Serving"):
 Every field except `model` has a default; a malformed line — invalid
 JSON, unknown fields, a bad model — is a structured error response
 for that line (with the request `id` echoed whenever the line parsed
-far enough to carry one), never a crash of the batch.
+far enough to carry one), never a crash of the batch. Instead of a
+registry `model`, a line may carry an inline `program` document
+(frontend/schema.py — README "Custom loop nests"); oversize lines,
+over-deep JSON, and hostile bounds products are refused with the
+same structured errors plus a `frontend_rejected` counter.
 
 Three introspection request types ride the same protocol:
 
@@ -41,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import time
 from typing import IO
 
@@ -56,6 +61,18 @@ from .executor import (
     default_runner,
 )
 from .fingerprint import request_fingerprint
+
+# The reserved model name for inline-program requests. Not a registry
+# entry: a request carries EITHER a registry model name (model/n/
+# tsteps address the builder) OR an inline frontend document
+# (`program`), in which case the model field is forced to this
+# sentinel so ledger rows, stats, and caches have a uniform label.
+CUSTOM_MODEL = "custom"
+
+# Hard per-line budget for the serve protocol. A frontend document
+# for any sane nest is a few KB; a line this long is hostile or a
+# client bug, and is refused BEFORE json.loads sees it.
+MAX_REQUEST_LINE_BYTES = 1 << 20
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +104,14 @@ class AnalysisRequest:
     # settings.
     fuse_refs: bool | None = None
     pipeline_depth: int | None = None
+    # Inline frontend document (frontend/schema.py) — the
+    # "MRC-as-a-service" path. Mutually exclusive with addressing a
+    # registry model: when set, `model` is the CUSTOM_MODEL sentinel
+    # and n/tsteps are ignored (the document IS the program). The
+    # fingerprint is taken over the canonical parsed IR, so two users
+    # submitting structurally identical nests coalesce/cache-hit
+    # exactly like repeat registry requests.
+    program: dict | None = None
     deadline_s: float | None = None
     id: str | None = None
     trace_id: str | None = None
@@ -99,15 +124,39 @@ class AnalysisRequest:
             )
         if self.runtime not in ("v1", "v2"):
             raise ValueError("runtime must be 'v1' or 'v2'")
+        if self.program is not None:
+            if not isinstance(self.program, dict):
+                raise ValueError("'program' must be a JSON object")
+            if self.model != CUSTOM_MODEL:
+                raise ValueError(
+                    "inline 'program' requests use model "
+                    f"{CUSTOM_MODEL!r}, not {self.model!r}"
+                )
+        elif self.model == CUSTOM_MODEL:
+            raise ValueError(
+                f"model {CUSTOM_MODEL!r} requires an inline 'program'"
+            )
 
     def build_program(self) -> Program:
+        if self.program is not None:
+            from ..frontend.parse import parse_program
+
+            return parse_program(self.program)
         return build_model(self.model, self.n, self.tsteps)
 
     def machine(self) -> MachineConfig:
-        return MachineConfig(
+        base = MachineConfig(
             thread_num=self.threads, chunk_size=self.chunk,
             ds=self.ds, cls=self.cls, cache_kb=self.cache_kb,
         )
+        if self.program is not None:
+            # document machine knobs override the request-level
+            # fields — a frontend document is a complete scenario on
+            # its own (the merged config is what gets fingerprinted)
+            from ..frontend.schema import machine_from_doc
+
+            return machine_from_doc(self.program, base)
+        return base
 
     def params(self) -> dict:
         """Engine parameters that shape the RESULT, and only those: an
@@ -134,6 +183,11 @@ class AnalysisRequest:
         d.pop("id")
         d.pop("deadline_s")
         d.pop("trace_id")
+        if d.get("program") is None:
+            # registry records keep their pre-frontend shape exactly
+            # (store bytes pinned); custom records embed the document
+            # so warm_from_ledger can replay them
+            d.pop("program")
         return d
 
     def fingerprint(self, program: Program | None = None) -> str:
@@ -459,8 +513,18 @@ class AnalysisService:
         from ..runtime.obs import metrics as obs_metrics
 
         t0 = time.perf_counter()
-        key = (request.model, request.n, request.tsteps,
-               dataclasses.astuple(request.machine()))
+        if request.program is not None:
+            # custom requests have no (model, n) address — memoize on
+            # the canonical IR content instead, so identical documents
+            # (whatever their JSON spelling) share one verdict
+            from .fingerprint import content_digest, program_payload
+
+            key = (CUSTOM_MODEL,
+                   content_digest(program_payload(program)),
+                   dataclasses.astuple(request.machine()))
+        else:
+            key = (request.model, request.n, request.tsteps,
+                   dataclasses.astuple(request.machine()))
         summary = self._preflight_memo.get(key)
         if summary is None:
             with telemetry.span("ir_preflight", model=request.model,
@@ -470,6 +534,16 @@ class AnalysisService:
                     program, request.machine()
                 )
             summary = report.summary()
+            if request.program is not None:
+                # the structural signature (16-hex digest form) rides
+                # the summary into the outcome and the ledger row, so
+                # model:"custom" rows stay attributable to a nest
+                # shape without replaying the document
+                from .fingerprint import structure_digest
+
+                summary = dict(summary)
+                summary["signature"] = structure_digest(
+                    report.signature)
             if len(self._preflight_memo) >= 256:
                 self._preflight_memo.clear()
             self._preflight_memo[key] = summary
@@ -524,7 +598,21 @@ class AnalysisService:
         request. Raises ValueError/KeyError for malformed requests
         (PreflightError for invalid IR) — `serve` turns those into
         per-line error responses."""
-        program = request.build_program()
+        if request.program is not None:
+            from ..frontend.parse import FrontendError
+
+            try:
+                program = request.build_program()
+            except FrontendError as e:
+                # the frontend's own gate (JSON shape / limits /
+                # hostile bounds): counted separately from IR
+                # preflight so operators can tell bad documents from
+                # bad nests, but ledgered the same way
+                self.executor._count("frontend_rejected")
+                self._ledger_rejection(request, str(e))
+                raise
+        else:
+            program = request.build_program()
         preflight = (
             self._run_preflight(request, program)
             if self.preflight else None
@@ -578,8 +666,21 @@ def parse_request_line(line: str) -> AnalysisRequest:
         raise ValueError(
             f"unknown request fields: {', '.join(sorted(unknown))}"
         )
-    if "model" not in doc:
-        raise ValueError("request needs a 'model'")
+    if "program" in doc:
+        # an inline document IS the scenario; a model/n/tsteps
+        # address alongside it would be ambiguous
+        clash = sorted({"model", "n", "tsteps"} & set(doc))
+        if clash:
+            raise ValueError(
+                "'program' is mutually exclusive with "
+                f"{', '.join(repr(c) for c in clash)}"
+            )
+        doc = dict(doc)
+        doc["model"] = CUSTOM_MODEL
+    elif "model" not in doc:
+        raise ValueError(
+            "request needs a 'model' (or an inline 'program')"
+        )
     return AnalysisRequest(**doc)
 
 
@@ -618,8 +719,30 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
             continue
         entry: dict = {"line": line_no, "id": None}
         entries.append(entry)
+        if len(line) > MAX_REQUEST_LINE_BYTES:
+            # refused before json.loads: the size cap is the OOM
+            # guard, so the oversize payload is never materialized as
+            # objects. Best-effort id echo from the line head only.
+            m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"', line[:4096])
+            if m:
+                entry["id"] = m.group(1)
+            entry["error"] = (
+                f"request line of {len(line)} bytes exceeds the "
+                f"{MAX_REQUEST_LINE_BYTES}-byte limit"
+            )
+            service.executor._count("frontend_rejected")
+            continue
         try:
             doc = json.loads(line)
+        except RecursionError:
+            # hostile nesting deep enough to blow the json parser's
+            # stack — same structured refusal as any bad document
+            m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"', line[:4096])
+            if m:
+                entry["id"] = m.group(1)
+            entry["error"] = "invalid JSON: nesting too deep"
+            service.executor._count("frontend_rejected")
+            continue
         except ValueError as e:
             entry["error"] = f"invalid JSON: {e}"
             continue

@@ -477,7 +477,7 @@ class RequestExecutor:
                     "ledger_rows", "ledger_write_failed",
                     "batches_formed", "batch_members",
                     "batch_fallback_solo", "preflight_rejected",
-                    "race_warnings"):
+                    "frontend_rejected", "race_warnings"):
             out.setdefault(key, 0)
         active = out.pop("active")
         out["in_flight"] = inflight
@@ -539,6 +539,7 @@ class RequestExecutor:
         "batch_members": "batch_members",
         "batch_fallback_solo": "service_batch_fallback_solo",
         "preflight_rejected": "ir_preflight_failures",
+        "frontend_rejected": "frontend_rejected",
         "race_warnings": "race_warnings",
     }
 
@@ -1164,6 +1165,11 @@ class RequestExecutor:
             # ("ok" | "race"; rejections write their own row from the
             # service with verdict "invalid")
             row["preflight"] = pf["verdict"]
+            if pf.get("signature"):
+                # custom (inline-program) rows carry the structural
+                # signature, so a model:"custom" row is attributable
+                # to a nest shape without replaying the document
+                row["signature"] = pf["signature"]
         for stage in ("queue_s", "batch_wait_s", "execute_s"):
             v = outcome.get(stage)
             if v is not None:

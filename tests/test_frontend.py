@@ -1,0 +1,412 @@
+"""Program frontend: schema round-trip, strict parse diagnostics,
+service integration of inline programs, serve robustness, the CLI
+dump/load paths, and the generative fuzz smoke.
+
+The acceptance invariants pinned here:
+- parse(dump(m)) is fingerprint-identical to m for the WHOLE registry;
+- a custom nest structurally equal to gemm produces the same
+  fingerprint AND byte-identical MRC (same mrc_digest) as the
+  registry request, via the service and via serve_jsonl;
+- warm repeat of a custom nest = zero engine executions;
+- hostile documents (oversize / over-deep / non-numeric / huge bounds
+  products) are structured per-line errors with the id echoed and the
+  `frontend_rejected` counter bumped — never a crash;
+- 25 fuzz seeds pass the cheap contract in tier-1 (sampled drift
+  sweep behind -m slow; the standing gate is tools/fuzz_ir.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu.cli import main
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.frontend import (
+    FrontendError,
+    malformed_doc_fixtures,
+    parse_program,
+    parse_program_doc,
+    program_to_json,
+)
+from pluss_sampler_optimization_tpu.frontend import fuzz
+from pluss_sampler_optimization_tpu.models import REGISTRY, build
+from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisRequest,
+    AnalysisService,
+    serve_jsonl,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+import check_ir  # noqa: E402
+import fuzz_ir  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _build(name: str, n: int):
+    try:
+        return build(name, n, 2)
+    except ValueError:
+        return build(name, n, 1)
+
+
+# -- schema round-trip ------------------------------------------------
+
+
+def test_roundtrip_whole_registry_fingerprint_identical():
+    """parse(dump(m)) == m, structurally AND by request fingerprint,
+    for every registry model (dumps carry the program name, so the
+    fingerprint identity comes for free)."""
+    from pluss_sampler_optimization_tpu.service.fingerprint import (
+        request_fingerprint,
+    )
+
+    machine = MachineConfig()
+    for name in sorted(REGISTRY):
+        program = _build(name, 8)
+        # through JSON text, as a serve payload would arrive
+        doc = json.loads(json.dumps(program_to_json(program)))
+        parsed = parse_program(doc)
+        assert parsed == program, name
+        assert (
+            request_fingerprint(parsed, machine, "exact", {})
+            == request_fingerprint(program, machine, "exact", {})
+        ), name
+
+
+def test_dump_is_explicit_and_versioned():
+    doc = program_to_json(build("gemm", 16))
+    assert doc["ir_version"] == 1
+    assert doc["name"] == "gemm-16x16x16"
+    for lp in doc["nests"][0]["loops"]:
+        assert set(lp) == {"trip", "start", "step", "trip_coeff",
+                           "start_coeff"}
+    for r in doc["nests"][0]["refs"]:
+        assert set(r) == {"name", "array", "level", "coeffs", "const",
+                          "slot", "share_threshold", "share_ratio",
+                          "write"}
+
+
+def test_machine_knobs_roundtrip():
+    m = MachineConfig(thread_num=2, chunk_size=3)
+    doc = program_to_json(build("gemm", 8), machine=m)
+    assert doc["machine"]["thread_num"] == 2
+    from pluss_sampler_optimization_tpu.frontend import machine_from_doc
+
+    merged = machine_from_doc(doc, MachineConfig())
+    assert merged.thread_num == 2 and merged.chunk_size == 3
+
+
+# -- strict parse diagnostics ----------------------------------------
+
+
+def test_every_malformed_doc_fixture_yields_its_code():
+    for name, (doc, want) in malformed_doc_fixtures().items():
+        res = parse_program_doc(doc)
+        assert res.program is None, name
+        codes = [d.code for d in res.errors()]
+        assert want in codes, (name, want, codes)
+
+
+def test_parse_program_raises_frontend_error_with_dict_diagnostics():
+    from pluss_sampler_optimization_tpu.analysis import PreflightError
+
+    doc, want = malformed_doc_fixtures()["step_zero"]
+    with pytest.raises(FrontendError) as ei:
+        parse_program(doc)
+    # FrontendError IS a PreflightError: every preflight-rejection
+    # consumer (serve_jsonl structured errors) handles it unchanged
+    assert isinstance(ei.value, PreflightError)
+    diags = ei.value.diagnostics
+    assert diags and isinstance(diags[0], dict)
+    assert any(d["code"] == want for d in diags)
+
+
+def test_custom_nest_rejects_like_malformed_registry_model():
+    """The no-drift property: a semantically bad custom nest gets the
+    SAME V_* code/path the shared validator gives malformed IR."""
+    from pluss_sampler_optimization_tpu import analysis
+
+    bag, want = analysis.malformed_fixtures()["step_zero"]
+    report = analysis.analyze_program(bag)
+    ir_codes = {d.code for d in report.diagnostics}
+    doc, _ = malformed_doc_fixtures()["step_zero"]
+    doc_codes = {d.code for d in parse_program_doc(doc).errors()}
+    assert want in ir_codes and want in doc_codes
+
+
+def test_access_cap_blocks_hostile_bounds_without_materializing():
+    doc, want = malformed_doc_fixtures()["hostile_bounds_product"]
+    res = parse_program_doc(doc)
+    assert res.program is None
+    assert [d.code for d in res.errors()] == [want]
+
+
+# -- service integration ----------------------------------------------
+
+
+def _gemm_doc(n: int = 16) -> dict:
+    return program_to_json(build("gemm", n))
+
+
+def test_custom_gemm_twin_same_fingerprint_and_mrc(tmp_path):
+    """The tentpole acceptance: a custom nest structurally equal to
+    gemm coalesces onto the registry request's cache slot and serves
+    byte-identical MRC bytes — and the warm custom repeat runs zero
+    engine work."""
+    tele = telemetry.enable()
+    ledger = str(tmp_path / "ledger.jsonl")
+    with AnalysisService(ledger_path=ledger) as svc:
+        reg = svc.analyze(AnalysisRequest(model="gemm", n=16,
+                                          engine="numpy"))
+        assert reg.ok and tele.counters["service_exec_started"] == 1
+        custom = svc.analyze(AnalysisRequest(
+            model="custom", program=_gemm_doc(), engine="numpy"))
+        assert custom.ok
+        # identical content address -> served from cache, no engine
+        assert custom.fingerprint == reg.fingerprint
+        assert custom.cache == "mem"
+        assert custom.mrc_digest == reg.mrc_digest
+        assert np.array_equal(custom.mrc, reg.mrc)
+        assert tele.counters["service_exec_started"] == 1
+        # custom preflight carries the structural signature
+        assert custom.preflight["verdict"] == "ok"
+        assert len(custom.preflight["signature"]) == 16
+    rows = [json.loads(ln) for ln in open(ledger)]
+    custom_rows = [r for r in rows if r.get("model") == "custom"]
+    assert custom_rows and custom_rows[0]["signature"] \
+        == custom.preflight["signature"]
+    # the embedded document makes the row replayable
+    assert custom_rows[0]["request"]["program"] == _gemm_doc()
+
+
+def test_custom_request_validation():
+    with pytest.raises(ValueError):
+        AnalysisRequest(model="gemm", program=_gemm_doc())
+    with pytest.raises(ValueError):
+        AnalysisRequest(model="custom")
+    with pytest.raises(ValueError):
+        AnalysisRequest(model="custom", program="not a dict")
+
+
+def test_custom_document_machine_overrides_request_fields():
+    doc = program_to_json(build("gemm", 8),
+                          machine=MachineConfig(thread_num=2))
+    req = AnalysisRequest(model="custom", program=doc, threads=8)
+    assert req.machine().thread_num == 2
+
+
+def test_registry_payload_shape_unchanged():
+    """Registry records keep their pre-frontend payload shape exactly
+    (no `program` key), so stored record bytes are pinned."""
+    payload = AnalysisRequest(model="gemm", n=8).payload()
+    assert "program" not in payload
+    assert "program" in AnalysisRequest(
+        model="custom", program=_gemm_doc()).payload()
+
+
+# -- serve_jsonl: inline programs + robustness ------------------------
+
+
+def _serve(svc, lines):
+    out = io.StringIO()
+    serve_jsonl(svc, io.StringIO("\n".join(lines) + "\n"), out)
+    return [json.loads(ln) for ln in out.getvalue().splitlines()]
+
+
+def test_serve_inline_program_matches_registry_line():
+    tele = telemetry.enable()
+    with AnalysisService() as svc:
+        docs = _serve(svc, [
+            json.dumps({"id": "r", "model": "gemm", "n": 16,
+                        "engine": "numpy"}),
+            json.dumps({"id": "c", "program": _gemm_doc(),
+                        "engine": "numpy"}),
+        ])
+    assert docs[0]["ok"] and docs[1]["ok"]
+    assert docs[0]["fingerprint"] == docs[1]["fingerprint"]
+    assert docs[0]["mrc_digest"] == docs[1]["mrc_digest"]
+    # both lines submit before any result is awaited, so the custom
+    # twin singleflight-coalesces onto the registry line's execution
+    assert tele.counters["service_exec_started"] == 1
+
+
+def test_serve_rejects_hostile_documents_structured():
+    bad_nests = {"ir_version": 1, "nests": [{
+        "loops": [{"trip": 1 << 12}, {"trip": 1 << 12},
+                  {"trip": 1 << 12}],
+        "refs": [{"name": "R0", "array": "A", "level": 2,
+                  "coeffs": [1 << 24, 1 << 12, 1]}] * 2}] * 16}
+    non_numeric = {"ir_version": 1, "nests": [{
+        "loops": [{"trip": "16"}],
+        "refs": [{"name": "R0", "array": "A", "level": 0,
+                  "coeffs": [1]}]}]}
+    deep = '{"id": "deep", "program": ' + "[" * 4000 + "]" * 4000 + "}"
+    big = json.dumps({"id": "big", "model": "gemm",
+                      "pad": "x" * (1 << 21)})
+    with AnalysisService() as svc:
+        docs = _serve(svc, [
+            json.dumps({"id": "hb", "program": bad_nests}),
+            json.dumps({"id": "nn", "program": non_numeric}),
+            deep,
+            big,
+            json.dumps({"id": "clash", "program": _gemm_doc(8),
+                        "model": "gemm"}),
+            json.dumps({"id": "ok", "model": "gemm", "n": 8,
+                        "engine": "numpy"}),
+        ])
+        stats = svc.executor.stats()
+    by_id = {d["id"]: d for d in docs}
+    assert not by_id["hb"]["ok"]
+    assert any(d["code"] == "F_ACCESSES"
+               for d in by_id["hb"]["diagnostics"])
+    assert not by_id["nn"]["ok"]
+    assert any(d["code"] == "V_COEFF_SHAPE"
+               for d in by_id["nn"]["diagnostics"])
+    # hostile JSON nesting and oversize lines: refused with the id
+    # echoed, never an unhandled exception
+    assert not by_id["deep"]["ok"] and "deep" in by_id["deep"]["error"]
+    assert not by_id["big"]["ok"] and "exceeds" in by_id["big"]["error"]
+    assert not by_id["clash"]["ok"]
+    assert "mutually exclusive" in by_id["clash"]["error"]
+    assert by_id["ok"]["ok"]
+    assert stats["frontend_rejected"] == 4  # hb, nn, deep, big
+
+
+def test_serve_custom_rejection_writes_ledger_row(tmp_path):
+    ledger = str(tmp_path / "ledger.jsonl")
+    doc, _ = malformed_doc_fixtures()["step_zero"]
+    with AnalysisService(ledger_path=ledger) as svc:
+        docs = _serve(svc, [json.dumps({"id": "x", "program": doc})])
+    assert not docs[0]["ok"] and docs[0]["diagnostics"]
+    rows = [json.loads(ln) for ln in open(ledger)]
+    assert rows and rows[0]["model"] == "custom"
+    assert rows[0]["preflight"] == "invalid"
+
+
+# -- CLI --------------------------------------------------------------
+
+
+def test_cli_dump_ir_roundtrips(capsys):
+    assert main(["--dump-ir", "gemm", "--n", "8"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert parse_program(doc) == build("gemm", 8)
+
+
+def test_cli_dump_ir_dir_covers_registry(tmp_path, capsys):
+    assert main(["--dump-ir-dir", str(tmp_path), "--n", "8"]) == 0
+    files = sorted(p for p in os.listdir(tmp_path))
+    assert files == sorted(f"{m}.json" for m in REGISTRY)
+    for f in files:
+        doc = json.load(open(tmp_path / f))
+        assert parse_program_doc(doc).ok, f
+
+
+def test_cli_program_json_acc_byte_identical(tmp_path, capsys):
+    """Direct CLI path: acc output through --program-json is byte-
+    identical to the registry model's run."""
+    assert main(["--dump-ir", "gemm", "--n", "8"]) == 0
+    doc_text = capsys.readouterr().out
+    path = tmp_path / "gemm8.json"
+    path.write_text(doc_text)
+    assert main(["acc", "--engine", "numpy", "--model", "gemm",
+                 "--n", "8"]) == 0
+    registry_out = capsys.readouterr().out
+    assert main(["acc", "--engine", "numpy",
+                 "--program-json", str(path)]) == 0
+    custom_out = capsys.readouterr().out
+    assert custom_out == registry_out
+
+
+def test_cli_program_json_rejection_exits_with_diagnostics(tmp_path):
+    doc, _ = malformed_doc_fixtures()["step_zero"]
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(doc))
+    with pytest.raises(SystemExit) as ei:
+        main(["analyze", "--program-json", str(path)])
+    assert "V_STEP_ZERO" in str(ei.value)
+
+
+def test_cli_analyze_program_json(tmp_path, capsys):
+    path = tmp_path / "gemm.json"
+    path.write_text(json.dumps(_gemm_doc(8)))
+    assert main(["analyze", "--program-json", str(path)]) == 0
+    assert "verdict ok" in capsys.readouterr().out
+
+
+# -- tools ------------------------------------------------------------
+
+
+def test_check_ir_tool_validates_files(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_gemm_doc(8)))
+    bad = tmp_path / "bad.json"
+    bad_doc, _ = malformed_doc_fixtures()["parallel_triangular"]
+    bad.write_text(json.dumps(bad_doc))
+    assert check_ir.main(["--ir-json", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out and "gemm-8x8x8" in out
+    assert check_ir.main(["--ir-json", str(good), str(bad),
+                          "--json"]) == 1
+    lines = [json.loads(ln)
+             for ln in capsys.readouterr().out.splitlines()]
+    assert lines[0]["verdict"] == "ok"
+    assert lines[1]["verdict"] == "invalid"
+    assert any(d["code"] == "V_PARALLEL_TRIANGULAR"
+               for d in lines[1]["diagnostics"])
+
+
+def test_check_ir_fixtures_include_doc_set(capsys):
+    assert check_ir.main(["--fixtures"]) == 0
+    out = capsys.readouterr().out
+    # 11 IR fixtures + the frontend document set, all passing
+    n = 11 + len(malformed_doc_fixtures())
+    assert f"{n}/{n}" in out
+
+
+def test_fuzz_ir_tool_fails_on_mismatch(monkeypatch, capsys):
+    """The gate exits nonzero when any seed reports errors."""
+    def fake_check_seed(seed, **kw):
+        return {"seed": seed, "ok": False, "program": f"fuzz{seed}",
+                "depth": 1, "refs": 1, "accesses": 0,
+                "sampled_drift": 9.9, "mutants_rejected": "0/0",
+                "errors": ["exact: synthetic mismatch"]}
+
+    monkeypatch.setattr(fuzz, "check_seed", fake_check_seed)
+    assert fuzz_ir.main(["--seeds", "2"]) == 1
+
+
+# -- fuzz smoke -------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_fuzz_smoke(seed):
+    """25-seed tier-1 smoke of the cheap contract: round-trip,
+    exact-engine bit-identity vs the numpy oracle, every mutant
+    rejected with its expected code. The sampled drift sweep rides
+    the slow marker below and the tools/fuzz_ir.py standing gate."""
+    r = fuzz.check_seed(seed, sampled=False)
+    assert r["ok"], r["errors"]
+    assert r["accesses"] >= fuzz.MIN_ACCESSES
+
+
+@pytest.mark.slow
+def test_fuzz_deep_with_sampled_drift():
+    summary = fuzz.run_seeds(40, sampled=True)
+    assert summary["failed"] == 0, summary["failures"]

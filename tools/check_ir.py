@@ -8,15 +8,24 @@ diagnostics, the dependence/race classification, and the locality
 bounds. No jax import, so the gate is instant.
 
     python tools/check_ir.py [--model NAME] [--n N] [--tsteps T]
-        [--json] [--fixtures]
+        [--json] [--fixtures] [--ir-json FILE ...]
 
 Exit code: nonzero when any program is INVALID (verdict "invalid") —
 a race verdict is a property of the modeled OpenMP program, not an
 input error, and exits 0. `--fixtures` instead runs the analyzer over
 the malformed-IR fixture set (analysis/validate.py::malformed_fixtures)
-and fails unless every fixture produces exactly its expected
-diagnostic code — the error-path self-test the service preflight
-rejection shares (tests/test_analysis.py runs both from tier-1).
+AND the frontend's malformed-document set
+(frontend/parse.py::malformed_doc_fixtures) and fails unless every
+fixture produces exactly its expected diagnostic code — the
+error-path self-test the service preflight rejection shares
+(tests/test_analysis.py runs both from tier-1).
+
+`--ir-json FILE ...` validates user-authored frontend documents
+(frontend/schema.py; write them with `--dump-ir`) offline through the
+SAME parse + analyze code path the service runs on inline `program`
+requests, so the offline gate and the serve rejection cannot drift:
+a file this gate passes will not be refused by serve, and the
+diagnostics printed here are the ones serve would return.
 """
 
 from __future__ import annotations
@@ -70,6 +79,88 @@ def check_fixtures() -> list[str]:
     return problems
 
 
+def check_doc_fixtures() -> list[str]:
+    """The frontend's malformed-document set through the strict
+    parser; returns mismatches (empty = every document is rejected
+    with its expected code)."""
+    from pluss_sampler_optimization_tpu.frontend.parse import (
+        malformed_doc_fixtures,
+        parse_program_doc,
+    )
+
+    problems = []
+    for key, (doc, want_code) in sorted(
+        malformed_doc_fixtures().items()
+    ):
+        res = parse_program_doc(doc)
+        if res.program is not None:
+            problems.append(f"doc:{key}: accepted, expected "
+                            f"{want_code}")
+            continue
+        codes = [d.code for d in res.errors()]
+        if want_code not in codes:
+            problems.append(
+                f"doc:{key}: expected diagnostic {want_code}, "
+                f"got {codes}"
+            )
+    return problems
+
+
+def check_ir_files(paths, as_json: bool) -> int:
+    """Validate frontend documents offline; one verdict line (or JSON
+    object) per file, nonzero when any file is rejected."""
+    from pluss_sampler_optimization_tpu import analysis
+    from pluss_sampler_optimization_tpu.config import MachineConfig
+    from pluss_sampler_optimization_tpu.frontend.parse import (
+        parse_program_doc,
+    )
+    from pluss_sampler_optimization_tpu.frontend.schema import (
+        machine_from_doc,
+    )
+
+    invalid = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            invalid += 1
+            if as_json:
+                print(json.dumps({"file": path, "verdict": "invalid",
+                                  "error": str(e)}, sort_keys=True))
+            else:
+                print(f"{path}: INVALID ({e})")
+            continue
+        res = parse_program_doc(doc)
+        if res.program is None:
+            invalid += 1
+            diags = [d.to_dict() for d in res.errors()]
+            if as_json:
+                print(json.dumps(
+                    {"file": path, "verdict": "invalid",
+                     "diagnostics": diags}, sort_keys=True))
+            else:
+                print(f"{path}: INVALID")
+                for d in res.errors():
+                    print(f"  [{d.severity}] {d.code} at "
+                          f"{d.path or '/'}: {d.message}")
+            continue
+        machine = machine_from_doc(doc, MachineConfig())
+        report = analysis.analyze_program(res.program, machine)
+        if as_json:
+            print(json.dumps(
+                {"file": path, "program": res.program.name,
+                 "accesses": res.total_accesses, **report.summary(),
+                 "wall_ms": round(report.wall_s * 1e3, 3)},
+                sort_keys=True))
+        else:
+            print(f"{path}: {report.verdict} "
+                  f"({res.program.name}, {res.total_accesses} "
+                  f"accesses, {len(report.races)} race pairs)")
+        invalid += 0 if report.ok else 1
+    return 1 if invalid else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="static IR analyzer gate over the model registry"
@@ -82,20 +173,33 @@ def main(argv=None) -> int:
                     help="emit one JSON object per model instead of "
                     "the table")
     ap.add_argument("--fixtures", action="store_true",
-                    help="check the malformed-IR fixture set instead "
-                    "of the registry (error-path self-test)")
+                    help="check the malformed-IR and malformed-"
+                    "document fixture sets instead of the registry "
+                    "(error-path self-test)")
+    ap.add_argument("--ir-json", nargs="+", default=None,
+                    metavar="FILE",
+                    help="validate frontend JSON documents offline "
+                    "(same parse+analyze path as the serve 'program' "
+                    "field; nonzero exit on any invalid file)")
     args = ap.parse_args(argv)
 
     if args.fixtures:
-        problems = check_fixtures()
+        problems = check_fixtures() + check_doc_fixtures()
         for p in problems:
             print(f"FIXTURE MISMATCH: {p}", file=sys.stderr)
         from pluss_sampler_optimization_tpu import analysis
+        from pluss_sampler_optimization_tpu.frontend.parse import (
+            malformed_doc_fixtures,
+        )
 
-        n = len(analysis.malformed_fixtures())
+        n = (len(analysis.malformed_fixtures())
+             + len(malformed_doc_fixtures()))
         print(f"fixtures: {n - len(problems)}/{n} produced their "
               "expected diagnostic code")
         return 1 if problems else 0
+
+    if args.ir_json:
+        return check_ir_files(args.ir_json, args.json)
 
     from pluss_sampler_optimization_tpu.models import REGISTRY
 

@@ -1,0 +1,84 @@
+"""Standing generative-fuzz gate for the program frontend.
+
+Sweeps seeded random loop-nest documents through the full frontend
+contract (frontend/fuzz.py): schema round-trip, exact-engine
+bit-identity vs the numpy oracle, sampled-engine MRC drift bound,
+and rejection-with-diagnostic for every invalid mutant.
+
+    python tools/fuzz_ir.py [--seeds N] [--start-seed S]
+        [--ratio R] [--drift-max D] [--mutants M] [--json] [-v]
+
+Exit code: nonzero on ANY oracle mismatch, drift violation, accepted
+mutant, or parser crash — so the sweep can run as a standing gate.
+Failures print the seed and the exact contract clause violated;
+re-run a single seed with `--seeds 1 --start-seed S` to reproduce
+(the generator is fully deterministic per seed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv=None) -> int:
+    from pluss_sampler_optimization_tpu.frontend import fuzz
+
+    ap = argparse.ArgumentParser(
+        description="generative IR fuzz gate (engines vs numpy oracle)"
+    )
+    ap.add_argument("--seeds", type=int, default=100,
+                    help="number of seeds to sweep (default 100)")
+    ap.add_argument("--start-seed", type=int, default=0)
+    ap.add_argument("--ratio", type=float, default=fuzz.RATIO,
+                    help="sampled-engine sampling ratio")
+    ap.add_argument("--drift-max", type=float, default=fuzz.DRIFT_MAX,
+                    help="max |MRC_sampled - MRC_oracle| allowed")
+    ap.add_argument("--mutants", type=int, default=4,
+                    help="invalid mutants per seed")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="one line per seed")
+    args = ap.parse_args(argv)
+
+    def progress(r):
+        if args.verbose:
+            print(f"seed {r['seed']:>4}: "
+                  f"{'ok' if r['ok'] else 'FAIL'} "
+                  f"depth {r['depth']} refs {r['refs']} "
+                  f"drift {r['sampled_drift']:.3f} "
+                  f"mutants {r['mutants_rejected']}",
+                  file=sys.stderr)
+
+    t0 = time.time()
+    summary = fuzz.run_seeds(
+        args.seeds, start=args.start_seed, ratio=args.ratio,
+        drift_max=args.drift_max, n_mutants=args.mutants,
+        progress=progress,
+    )
+    summary["wall_s"] = round(time.time() - t0, 1)
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        for f in summary["failures"]:
+            for err in f["errors"]:
+                print(f"SEED {f['seed']} FAIL: {err}",
+                      file=sys.stderr)
+        print(f"fuzz: {summary['passed']}/{summary['seeds']} seeds "
+              f"passed (worst sampled drift "
+              f"{summary['worst_drift']:.3f} at seed "
+              f"{summary['worst_drift_seed']}, ratio "
+              f"{summary['ratio']}, {summary['wall_s']}s)")
+    return 1 if summary["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
