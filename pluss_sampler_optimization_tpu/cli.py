@@ -500,6 +500,88 @@ def main(argv=None) -> int:
         "\"Replica serving\".",
     )
     ap.add_argument(
+        "--fault-spec",
+        default=None,
+        metavar="FILE",
+        help="serve mode: arm deterministic fault injection from a "
+        "JSON spec ({\"seed\": S, \"rules\": [{\"site\": ..., "
+        "\"kind\": ..., \"p\": ..., ...}]}). Sites: engine_execute, "
+        "replica_dispatch, cache_load, cache_store, serve_line; "
+        "kinds: raise, latency, hang, corrupt, compile_failure. "
+        "Decisions come from a seeded counter hash, so a chaos run "
+        "replays exactly from (seed, spec). See README \"Overload, "
+        "retries & chaos testing\".",
+    )
+    ap.add_argument(
+        "--attempt-timeout-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="service-routed runs (--cache-dir / serve mode): bound "
+        "every engine attempt to SECONDS (tighter of this and the "
+        "request deadline); an overrun attempt is abandoned and — "
+        "with --max-retries — retried with seeded exponential "
+        "backoff. Default: attempts are bounded by the request "
+        "deadline only.",
+    )
+    ap.add_argument(
+        "--max-retries",
+        type=int,
+        default=None,
+        metavar="N",
+        help="service-routed runs: retry a failed or timed-out "
+        "engine attempt up to N times (deterministic seeded backoff "
+        "jitter — replays exactly) before degrading down the chain "
+        "(default: 0, no retries)",
+    )
+    ap.add_argument(
+        "--hedge-after-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="service-routed runs with >= 2 replicas: duplicate a "
+        "dispatch still unresolved after SECONDS onto a second "
+        "replica; first result wins, the queued loser is cancelled. "
+        "Results are bit-identical either way (tail-latency "
+        "insurance only). Default: no hedging.",
+    )
+    ap.add_argument(
+        "--queue-limit",
+        type=int,
+        default=None,
+        metavar="N",
+        help="service-routed runs: admission control — shed a "
+        "submission (structured `shed: true` response in "
+        "microseconds) when the executor queue is already N deep "
+        "for its priority class (low sheds at 50%% of N, normal at "
+        "75%%, high at 100%%). Default: unbounded queue, no "
+        "shedding.",
+    )
+    ap.add_argument(
+        "--no-shed",
+        action="store_true",
+        help="with --queue-limit: disable the shedding gate (keep "
+        "the limit configured but admit everything) — the overload "
+        "baseline tools/check_chaos.py and bench.py compare against",
+    )
+    ap.add_argument(
+        "--breaker-failures",
+        type=int,
+        default=None,
+        metavar="N",
+        help="service-routed runs: consecutive failures that OPEN a "
+        "per-engine/per-replica circuit breaker (default: 8)",
+    )
+    ap.add_argument(
+        "--breaker-probation-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="service-routed runs: how long an open breaker fails "
+        "fast before admitting one half-open probe; a failed probe "
+        "re-opens with the probation escalated (default: 30)",
+    )
+    ap.add_argument(
         "--warmup-from-ledger",
         type=int,
         default=None,
@@ -688,6 +770,11 @@ def main(argv=None) -> int:
                 "compaction for serve mode only (offline ledgers are "
                 "compacted by tools/check_ledger.py --gc)"
             )
+        if args.fault_spec is not None:
+            raise SystemExit(
+                "--fault-spec arms deterministic fault injection on "
+                "the serving hot paths; it applies to serve mode only"
+            )
     if args.ledger_gc_interval_s is not None and not args.ledger:
         raise SystemExit(
             "--ledger-gc-interval-s compacts the run ledger; it "
@@ -697,6 +784,23 @@ def main(argv=None) -> int:
     if args.replicas is not None and args.replicas < 0:
         raise SystemExit("--replicas must be >= 0 (0 = auto, one "
                          "replica per device)")
+    if args.queue_limit is not None and args.queue_limit < 1:
+        raise SystemExit("--queue-limit must be >= 1")
+    if args.no_shed and args.queue_limit is None:
+        raise SystemExit(
+            "--no-shed disables the admission gate configured by "
+            "--queue-limit; it needs --queue-limit N"
+        )
+    if args.max_retries is not None and args.max_retries < 0:
+        raise SystemExit("--max-retries must be >= 0")
+    if args.attempt_timeout_s is not None and args.attempt_timeout_s <= 0:
+        raise SystemExit("--attempt-timeout-s must be > 0")
+    if args.hedge_after_s is not None and args.hedge_after_s <= 0:
+        raise SystemExit("--hedge-after-s must be > 0")
+    if args.breaker_failures is not None and args.breaker_failures < 1:
+        raise SystemExit("--breaker-failures must be >= 1")
+    if args.breaker_probation_s is not None and args.breaker_probation_s <= 0:
+        raise SystemExit("--breaker-probation-s must be > 0")
     if args.warmup_from_ledger is not None and not args.ledger:
         raise SystemExit(
             "--warmup-from-ledger reads kernel signatures from the "
@@ -800,6 +904,22 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--replicas partitions the service's devices into "
             "replica executors; it needs --cache-dir (or serve mode)"
+        )
+    _res_flags = [
+        flag for flag, on in (
+            ("--attempt-timeout-s", args.attempt_timeout_s is not None),
+            ("--max-retries", args.max_retries is not None),
+            ("--hedge-after-s", args.hedge_after_s is not None),
+            ("--queue-limit", args.queue_limit is not None),
+            ("--breaker-failures", args.breaker_failures is not None),
+            ("--breaker-probation-s",
+             args.breaker_probation_s is not None),
+        ) if on
+    ]
+    if _res_flags and not args.cache_dir:
+        raise SystemExit(
+            f"{', '.join(_res_flags)} configure(s) service-routed "
+            "execution; they need --cache-dir (or serve mode)"
         )
 
     return _observed(
@@ -927,16 +1047,52 @@ def _request_from_args(args, engine):
     )
 
 
+def _resilience_from_args(args):
+    """ResilienceConfig from the CLI flags, or None when every flag is
+    at its default (the executor then runs the stock config — retries
+    off, no admission gate, breakers at their defaults)."""
+    if all(
+        v is None for v in (
+            args.attempt_timeout_s, args.max_retries,
+            args.hedge_after_s, args.queue_limit,
+            args.breaker_failures, args.breaker_probation_s,
+        )
+    ):
+        return None
+    from .config import ResilienceConfig
+
+    kw = {}
+    if args.attempt_timeout_s is not None:
+        kw["attempt_timeout_s"] = args.attempt_timeout_s
+    if args.max_retries is not None:
+        kw["max_retries"] = args.max_retries
+    if args.hedge_after_s is not None:
+        kw["hedge_after_s"] = args.hedge_after_s
+    if args.queue_limit is not None:
+        kw["queue_limit"] = args.queue_limit
+        kw["shed_enabled"] = not args.no_shed
+    if args.breaker_failures is not None:
+        kw["breaker_failures"] = args.breaker_failures
+    if args.breaker_probation_s is not None:
+        kw["breaker_probation_s"] = args.breaker_probation_s
+    return ResilienceConfig(**kw)
+
+
 def _serve(args) -> int:
     """`serve` mode: process a JSONL request batch end to end, under
     the live metrics registry (always on here — the `metrics` request
     type and the optional --metrics-port scrape read it), the
     optional SLO sentinel, the optional flight recorder
-    (--debug-bundle-dir), and the optional background ledger GC."""
+    (--debug-bundle-dir), the optional background ledger GC, and —
+    when armed — deterministic fault injection (--fault-spec).
+    SIGTERM/SIGINT trigger a graceful drain: in-flight work finishes,
+    queued work is shed with structured responses, and the ledger
+    (plus a final flight-recorder bundle) is flushed before exit."""
+    from .runtime import faults
     from .runtime.obs import ledger as obs_ledger
     from .runtime.obs import metrics as obs_metrics
     from .runtime.obs import recorder as obs_recorder
-    from .service import AnalysisService, serve_jsonl
+    from .service import AnalysisService, GracefulShutdown, serve_jsonl
 
     fin = sys.stdin if args.requests == "-" else open(args.requests)
     fout = (
@@ -949,6 +1105,17 @@ def _serve(args) -> int:
     recorder = None
     gc = None
     prev_usr2 = None
+    prev_sigs = {}
+    injector = None
+    failures = 0
+    if args.fault_spec:
+        injector = faults.install_from_file(args.fault_spec)
+        print(
+            f"serve: fault injection armed from {args.fault_spec} "
+            f"(seed {injector.config.seed}, "
+            f"{len(injector.config.rules)} rule(s))",
+            file=sys.stderr,
+        )
     if args.debug_bundle_dir is not None:
         recorder = obs_recorder.enable(
             args.debug_bundle_dir,
@@ -964,6 +1131,9 @@ def _serve(args) -> int:
                     "slo_burn_threshold", "slo_interval_s",
                     "debug_bundle_dir", "regress_bench",
                     "ledger_gc_interval_s", "ledger_max_rows",
+                    "fault_spec", "attempt_timeout_s", "max_retries",
+                    "hedge_after_s", "queue_limit", "no_shed",
+                    "breaker_failures", "breaker_probation_s",
                 )
             },
         )
@@ -989,12 +1159,32 @@ def _serve(args) -> int:
             except ValueError:
                 prev_usr2 = None
     try:
+        # SIGTERM/SIGINT = drain, don't drop: the handler raises
+        # GracefulShutdown (a BaseException, so serve_jsonl's per-line
+        # `except Exception` guards can't swallow it) on the main
+        # thread; serve_jsonl catches it, stops admission, finishes
+        # in-flight work, and sheds the rest with structured
+        # responses. Same main-thread-only caveat as SIGUSR2 above.
+        import signal
+
+        def _graceful(signum, frame):
+            raise GracefulShutdown(f"signal {signum}")
+
+        for _name in ("SIGTERM", "SIGINT"):
+            _num = getattr(signal, _name, None)
+            if _num is None:
+                continue
+            try:
+                prev_sigs[_num] = signal.signal(_num, _graceful)
+            except ValueError:
+                pass
         with AnalysisService(
             cache_dir=args.cache_dir, max_workers=args.max_workers,
             ledger_path=args.ledger,
             batch_window_ms=args.batch_window_ms,
             batch_max_refs=args.batch_max_refs,
             replicas=args.replicas,
+            resilience=_resilience_from_args(args),
         ) as svc:
             if recorder is not None:
                 # live serving state for bundles: replica/mesh view +
@@ -1057,6 +1247,24 @@ def _serve(args) -> int:
                 ).start()
                 svc.slo_sentinel = sentinel
             failures = serve_jsonl(svc, fin, fout)
+            if svc.executor.draining:
+                st = svc.executor.stats()
+                print(
+                    "serve: graceful shutdown — in-flight work "
+                    f"drained, {st.get('shed', 0)} request(s) shed",
+                    file=sys.stderr,
+                )
+                if recorder is not None:
+                    recorder.dump(
+                        "shutdown",
+                        trigger={"reason": "graceful_shutdown"},
+                    )
+            if injector is not None and injector.total_fired():
+                print(
+                    f"serve: faults fired {injector.total_fired()} "
+                    f"time(s): {injector.stats()}",
+                    file=sys.stderr,
+                )
             if sentinel is not None:
                 # short batches finish inside one interval; the final
                 # evaluation guarantees every serve run gets (at
@@ -1074,7 +1282,22 @@ def _serve(args) -> int:
                     gc.run_once()
                 except Exception:
                     pass
+    except GracefulShutdown:
+        # signal landed outside serve_jsonl (startup/teardown window)
+        # — still a clean exit, nothing was being served
+        print("serve: shutdown signal received outside the serving "
+              "loop; exiting", file=sys.stderr)
     finally:
+        if injector is not None:
+            faults.uninstall()
+        if prev_sigs:
+            import signal
+
+            for _num, _prev in prev_sigs.items():
+                try:
+                    signal.signal(_num, _prev)
+                except ValueError:
+                    pass
         if gc is not None:
             gc.close()
         if sentinel is not None:
@@ -1116,6 +1339,7 @@ def _execute_via_service(args, machine, program, engine) -> int:
         batch_window_ms=args.batch_window_ms,
         batch_max_refs=args.batch_max_refs,
         replicas=args.replicas,
+        resilience=_resilience_from_args(args),
     ) as svc:
         if args.mode == "speed":
             times = []

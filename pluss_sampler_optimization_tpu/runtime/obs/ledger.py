@@ -180,6 +180,15 @@ def validate_row(row) -> list[str]:
         # optional, rows from preflight-disabled services omit it
         if "preflight" in row:
             need_str("preflight", nullable=True)
+        # resilience outcomes (service/executor.py): shed = refused at
+        # the admission gate; hedged = a duplicate dispatch raced for
+        # this row; retries = extra attempts spent. All optional —
+        # quiet rows omit them, keeping their pre-resilience bytes
+        for flag in ("shed", "hedged"):
+            if flag in row and not isinstance(row[flag], bool):
+                errors.append(f"'{flag}' must be a boolean")
+        if "retries" in row:
+            need_num("retries", nullable=True)
     elif kind == "drift":
         need_str("model")
         need_num("n")
@@ -406,7 +415,8 @@ def aggregate(rows: list[dict]) -> dict:
     # `coalesced` count for singleflight joiners
     service = {"submitted": 0, "coalesced": 0, "completed": 0,
                "failed": 0, "degraded": 0, "preflight_rejected": 0,
-               "race_flagged": 0}
+               "race_flagged": 0, "shed": 0, "retried": 0,
+               "hedged": 0}
     # per-replica occupancy at execution grain: one request row per
     # served execution, grouped by the replica that ran it — the
     # ledger face of the executor's `replicas` snapshot and the
@@ -420,9 +430,21 @@ def aggregate(rows: list[dict]) -> dict:
                 joiners = int(row.get("coalesced") or 0)
                 service["submitted"] += 1 + joiners
                 service["coalesced"] += joiners
-                service["completed" if row["ok"] else "failed"] += 1
+                # shed rows are neither completions nor failures: the
+                # service refused the work at the admission gate (the
+                # same three-way split the executor's live counters
+                # report)
+                if row.get("shed"):
+                    service["shed"] += 1
+                else:
+                    service[
+                        "completed" if row["ok"] else "failed"
+                    ] += 1
                 if row.get("degraded"):
                     service["degraded"] += 1
+                service["retried"] += int(row.get("retries") or 0)
+                if row.get("hedged"):
+                    service["hedged"] += 1
                 pf = row.get("preflight")
                 if pf == "invalid":
                     service["preflight_rejected"] += 1
@@ -589,6 +611,14 @@ def format_stats(agg: dict) -> list[str]:
             "failed=%d degraded=%d" % (
                 svc["submitted"], svc["coalesced"], svc["completed"],
                 svc["failed"], svc["degraded"],
+            )
+        )
+    if svc and (svc.get("shed") or svc.get("retried")
+                or svc.get("hedged")):
+        lines.append(
+            "resilience: shed=%d retried=%d hedged=%d" % (
+                svc.get("shed", 0), svc.get("retried", 0),
+                svc.get("hedged", 0),
             )
         )
     if agg["bench_rows"]:

@@ -56,7 +56,8 @@ BUNDLE_VERSION = 1
 ACCEPTED_BUNDLE_VERSIONS = (1,)
 
 # Bundle reasons: the five anomaly/explicit trigger paths plus the
-# regression sentinel and the SIGUSR2 serve hook.
+# regression sentinel, the SIGUSR2 serve hook, and the final bundle
+# the serve loop writes on graceful shutdown (SIGTERM/SIGINT drain).
 REASONS = (
     "slo_breach",
     "request_failure",
@@ -65,6 +66,7 @@ REASONS = (
     "perf_regression",
     "dump_debug",
     "signal",
+    "shutdown",
 )
 
 # telemetry.event() names that fire a bundle when they reach the

@@ -5,10 +5,15 @@ caching (two-tier, versioned, corruption-tolerant — service/cache.py),
 canonical request fingerprints (service/fingerprint.py), singleflight
 request execution with deadlines and engine degradation
 (service/executor.py), replica-pool device partitioning with
-load-aware routing, work stealing, and failure quarantine
-(service/replicas.py), and the submit/result + JSONL serving API
-(service/api.py). CLI entry points: `serve` mode, `--cache-dir`, and
-`--replicas` (cli.py); store audits: tools/check_service_store.py.
+load-aware routing, work stealing, and breaker-gated recovery
+(service/replicas.py), chaos-grade resilience — per-attempt timeouts
+with seeded-backoff retries, hedged dispatch, circuit breakers with
+half-open probation (service/breakers.py), and admission-controlled
+load shedding — and the submit/result + JSONL serving API with
+graceful drain (service/api.py). CLI entry points: `serve` mode,
+`--cache-dir`, `--replicas`, `--fault-spec`, and the resilience
+flags (cli.py); store audits: tools/check_service_store.py; the
+seeded chaos gate: tools/check_chaos.py.
 """
 
 from .api import (
@@ -16,12 +21,15 @@ from .api import (
     AnalysisResponse,
     AnalysisService,
     AnalysisTicket,
+    GracefulShutdown,
     parse_request_line,
     serve_jsonl,
 )
+from .breakers import CircuitBreaker
 from .cache import STORE_VERSION, ResultCache, validate_record
 from .executor import (
     DEGRADE_CHAINS,
+    PRIORITY_CLASSES,
     SERVICE_ENGINES,
     RequestExecutor,
     default_runner,
@@ -41,6 +49,9 @@ __all__ = [
     "AnalysisResponse",
     "AnalysisService",
     "AnalysisTicket",
+    "GracefulShutdown",
+    "CircuitBreaker",
+    "PRIORITY_CLASSES",
     "parse_request_line",
     "serve_jsonl",
     "STORE_VERSION",

@@ -47,6 +47,7 @@ import dataclasses
 import json
 import re
 import time
+from concurrent.futures import CancelledError
 from typing import IO
 
 import numpy as np
@@ -54,13 +55,23 @@ import numpy as np
 from ..config import MachineConfig
 from ..ir import Program
 from ..models import build as build_model
+from ..runtime import faults
 from .cache import ResultCache
 from .executor import (
+    PRIORITY_CLASSES,
     SERVICE_ENGINES,
     RequestExecutor,
     default_runner,
 )
 from .fingerprint import request_fingerprint
+
+
+class GracefulShutdown(BaseException):
+    """Raised by the CLI's SIGTERM/SIGINT handlers to unwind
+    serve_jsonl. A BaseException on purpose: the serve loop's
+    per-line `except Exception` robustness handlers must NOT swallow
+    a shutdown into a structured error response — only the dedicated
+    handlers in serve_jsonl may catch it."""
 
 # The reserved model name for inline-program requests. Not a registry
 # entry: a request carries EITHER a registry model name (model/n/
@@ -113,6 +124,10 @@ class AnalysisRequest:
     # exactly like repeat registry requests.
     program: dict | None = None
     deadline_s: float | None = None
+    # Admission priority class (executor.py::PRIORITY_CLASSES): under
+    # overload, low-priority work is shed first and high-priority
+    # last. Pure serving policy — never in the fingerprint
+    priority: str = "normal"
     id: str | None = None
     trace_id: str | None = None
 
@@ -121,6 +136,11 @@ class AnalysisRequest:
             raise ValueError(
                 f"unknown service engine {self.engine!r} "
                 f"(have {', '.join(SERVICE_ENGINES)})"
+            )
+        if self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {self.priority!r} "
+                f"(have {', '.join(PRIORITY_CLASSES)})"
             )
         if self.runtime not in ("v1", "v2"):
             raise ValueError("runtime must be 'v1' or 'v2'")
@@ -183,6 +203,7 @@ class AnalysisRequest:
         d.pop("id")
         d.pop("deadline_s")
         d.pop("trace_id")
+        d.pop("priority")
         if d.get("program") is None:
             # registry records keep their pre-frontend shape exactly
             # (store bytes pinned); custom records embed the document
@@ -238,6 +259,13 @@ class AnalysisResponse:
     # the static-analysis gate; None when preflight is disabled.
     # Serving metadata: the verdict never shapes the MRC bytes
     preflight: dict | None = None
+    # resilience outcomes (serving metadata): shed = refused at the
+    # admission gate (ok is False but nothing failed — the service
+    # declined the work); retries/hedged report what the executor
+    # spent getting the (bit-identical) result
+    shed: bool = False
+    retries: int = 0
+    hedged: bool = False
 
     def to_jsonl_dict(self) -> dict:
         """The wire form `serve` emits: compact — the MRC ships in the
@@ -265,6 +293,12 @@ class AnalysisResponse:
             d["replica_id"] = self.replica_id
         if self.preflight is not None:
             d["preflight"] = self.preflight
+        if self.shed:
+            d["shed"] = True
+        if self.retries:
+            d["retries"] = self.retries
+        if self.hedged:
+            d["hedged"] = True
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
@@ -295,6 +329,9 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             span_id=outcome.get("span_id"),
             replica_id=outcome.get("replica_id"),
             preflight=outcome.get("preflight"),
+            shed=bool(outcome.get("shed")),
+            retries=int(outcome.get("retries") or 0),
+            hedged=bool(outcome.get("hedged")),
         )
     return AnalysisResponse(
         id=request.id,
@@ -317,6 +354,8 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         span_id=outcome.get("span_id"),
         replica_id=outcome.get("replica_id"),
         preflight=outcome.get("preflight"),
+        retries=int(outcome.get("retries") or 0),
+        hedged=bool(outcome.get("hedged")),
     )
 
 
@@ -331,7 +370,8 @@ class AnalysisService:
                  batch_window_ms: float | None = None,
                  batch_max_refs: int = 64,
                  replicas=None,
-                 preflight: bool = True):
+                 preflight: bool = True,
+                 resilience=None):
         from ..config import BatchConfig
 
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
@@ -358,7 +398,19 @@ class AnalysisService:
             # int | ReplicaConfig | None (None = no pool, the PR 9
             # single-device-set behavior)
             replicas=replicas,
+            # ResilienceConfig | None (None = every layer off/neutral:
+            # no retries, no hedging, no admission limit — the
+            # pre-resilience behavior, bit for bit)
+            resilience=resilience,
         )
+
+    def begin_shutdown(self) -> None:
+        """Enter graceful drain: later submits shed at the admission
+        gate, queued-but-unstarted work cancels (its waiters get
+        structured shed responses from serve_jsonl), executions
+        already running finish and are answered normally. Idempotent;
+        `close()` still performs the final teardown."""
+        self.executor.drain()
 
     def warm_from_ledger(self, top_n: int) -> int:
         """Ledger-driven warm start: pre-compile the sampled kernel
@@ -709,85 +761,116 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     request line above them has been awaited, so the live histograms
     (and the post-mortem bundle's ring records) they report are
     deterministic within a batch.
+
+    Graceful shutdown: a GracefulShutdown raised into either pass
+    (the CLI's SIGTERM/SIGINT handlers) stops reading, drains
+    in-flight work to completion, and answers everything already
+    submitted — finished results normally, queued-then-cancelled work
+    with structured `shed: true` responses. Every submitted request
+    resolves exactly once either way.
     """
     # each entry: {"line", "id", and one of "ticket"+"request" |
     # "control" | "error"}
     entries: list[dict] = []
-    for line_no, line in enumerate(in_stream, start=1):
-        line = line.strip()
-        if not line:
-            continue
-        entry: dict = {"line": line_no, "id": None}
-        entries.append(entry)
-        if len(line) > MAX_REQUEST_LINE_BYTES:
-            # refused before json.loads: the size cap is the OOM
-            # guard, so the oversize payload is never materialized as
-            # objects. Best-effort id echo from the line head only.
-            m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"', line[:4096])
-            if m:
-                entry["id"] = m.group(1)
-            entry["error"] = (
-                f"request line of {len(line)} bytes exceeds the "
-                f"{MAX_REQUEST_LINE_BYTES}-byte limit"
-            )
-            service.executor._count("frontend_rejected")
-            continue
-        try:
-            doc = json.loads(line)
-        except RecursionError:
-            # hostile nesting deep enough to blow the json parser's
-            # stack — same structured refusal as any bad document
-            m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"', line[:4096])
-            if m:
-                entry["id"] = m.group(1)
-            entry["error"] = "invalid JSON: nesting too deep"
-            service.executor._count("frontend_rejected")
-            continue
-        except ValueError as e:
-            entry["error"] = f"invalid JSON: {e}"
-            continue
-        if isinstance(doc, dict):
-            # echo the id on EVERY response for this line, even when
-            # the rest of the request is malformed
-            entry["id"] = doc.get("id")
-        if isinstance(doc, dict) and doc.get("type") is not None:
-            kind = doc.get("type")
-            if kind not in CONTROL_TYPES:
-                entry["error"] = (
-                    f"unknown request type {kind!r} "
-                    f"(have {', '.join(CONTROL_TYPES)})"
-                )
+    try:
+        for line_no, line in enumerate(in_stream, start=1):
+            line = line.strip()
+            if not line:
                 continue
-            if kind in _DEFERRED_CONTROL_TYPES:
-                # deferred to the response pass: every request line
-                # ABOVE this one has been awaited by then, so a
-                # metrics snapshot deterministically includes their
-                # stage histograms and a dump_debug bundle includes
-                # their ring records (read-time evaluation would race
-                # with worker completion)
-                entry["control"] = {"type": kind, "payload": None}
+            entry: dict = {"line": line_no, "id": None}
+            entries.append(entry)
+            if len(line) > MAX_REQUEST_LINE_BYTES:
+                # refused before json.loads: the size cap is the OOM
+                # guard, so the oversize payload is never materialized
+                # as objects. Best-effort id echo from the head only.
+                m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"',
+                              line[:4096])
+                if m:
+                    entry["id"] = m.group(1)
+                entry["error"] = (
+                    f"request line of {len(line)} bytes exceeds the "
+                    f"{MAX_REQUEST_LINE_BYTES}-byte limit"
+                )
+                service.executor._count("frontend_rejected")
                 continue
             try:
-                payload = (
-                    service.healthz() if kind == "healthz"
-                    else service.stats()
-                )
-                entry["control"] = {"type": kind, "payload": payload}
+                # chaos site: a raise-kind fault on this line is one
+                # structured error response, never a stream abort —
+                # the same robustness contract malformed JSON gets
+                faults.fire("serve_line", key=line_no)
+                doc = json.loads(line)
+            except faults.FaultInjected as e:
+                entry["error"] = f"fault injected: {e}"
+                continue
+            except RecursionError:
+                # hostile nesting deep enough to blow the json
+                # parser's stack — same refusal as any bad document
+                m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"',
+                              line[:4096])
+                if m:
+                    entry["id"] = m.group(1)
+                entry["error"] = "invalid JSON: nesting too deep"
+                service.executor._count("frontend_rejected")
+                continue
+            except ValueError as e:
+                entry["error"] = f"invalid JSON: {e}"
+                continue
+            if isinstance(doc, dict):
+                # echo the id on EVERY response for this line, even
+                # when the rest of the request is malformed
+                entry["id"] = doc.get("id")
+            if isinstance(doc, dict) and doc.get("type") is not None:
+                kind = doc.get("type")
+                if kind not in CONTROL_TYPES:
+                    entry["error"] = (
+                        f"unknown request type {kind!r} "
+                        f"(have {', '.join(CONTROL_TYPES)})"
+                    )
+                    continue
+                if kind in _DEFERRED_CONTROL_TYPES:
+                    # deferred to the response pass: every request
+                    # line ABOVE this one has been awaited by then,
+                    # so a metrics snapshot deterministically includes
+                    # their stage histograms and a dump_debug bundle
+                    # includes their ring records (read-time
+                    # evaluation would race with worker completion)
+                    entry["control"] = {"type": kind, "payload": None}
+                    continue
+                try:
+                    payload = (
+                        service.healthz() if kind == "healthz"
+                        else service.stats()
+                    )
+                    entry["control"] = {"type": kind,
+                                        "payload": payload}
+                except Exception as e:
+                    entry["error"] = f"introspection failed: {e!r}"
+                continue
+            try:
+                request = parse_request_line(line)
+                entry["ticket"] = service.submit(request)
+                entry["request"] = request
             except Exception as e:
-                entry["error"] = f"introspection failed: {e!r}"
-            continue
-        try:
-            request = parse_request_line(line)
-            entry["ticket"] = service.submit(request)
-            entry["request"] = request
-        except Exception as e:
-            entry["error"] = _error_msg(e)
-            # preflight rejections carry machine-readable diagnostics
-            # (code / nest-ref path / message) — surface them on the
-            # structured error response
-            diags = getattr(e, "diagnostics", None)
-            if diags:
-                entry["diagnostics"] = diags
+                entry["error"] = _error_msg(e)
+                # preflight rejections carry machine-readable
+                # diagnostics (code / nest-ref path / message) —
+                # surface them on the structured error response
+                diags = getattr(e, "diagnostics", None)
+                if diags:
+                    entry["diagnostics"] = diags
+    except GracefulShutdown:
+        # stop READING and start draining; every line read so far
+        # still gets its response below (in-flight work finishes,
+        # queued work sheds). If the interrupted line never produced
+        # an entry beyond the placeholder, answer it as shed too.
+        service.begin_shutdown()
+        if entries and not any(
+            k in entries[-1] for k in ("ticket", "control", "error")
+        ):
+            entries[-1]["error"] = (
+                "shed: service shutting down (line not processed)"
+            )
+            entries[-1]["shed"] = True
     failures = 0
     for entry in entries:
         if "control" in entry:
@@ -809,18 +892,37 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
                 entry["control"]["type"]: payload,
             }
         elif "ticket" in entry:
-            try:
-                response = service.result(entry["ticket"])
-                doc = response.to_jsonl_dict()
-            except Exception as e:
-                # a result()/serialization blow-up is THIS request's
-                # error, never the batch's
-                doc = {
-                    "id": entry["request"].id,
-                    "ok": False,
-                    "line": entry["line"],
-                    "error": f"execution failed: {e!r}",
-                }
+            while True:
+                try:
+                    response = service.result(entry["ticket"])
+                    doc = response.to_jsonl_dict()
+                except GracefulShutdown:
+                    # the signal landed while awaiting a result:
+                    # enter the drain and keep answering — every
+                    # submitted entry still gets exactly one response
+                    service.begin_shutdown()
+                    continue
+                except CancelledError:
+                    # this entry's queued work was cancelled by the
+                    # drain before it started executing
+                    doc = {
+                        "id": entry["request"].id,
+                        "ok": False,
+                        "line": entry["line"],
+                        "shed": True,
+                        "error": ("shed: service shutting down "
+                                  "(queued request cancelled)"),
+                    }
+                except Exception as e:
+                    # a result()/serialization blow-up is THIS
+                    # request's error, never the batch's
+                    doc = {
+                        "id": entry["request"].id,
+                        "ok": False,
+                        "line": entry["line"],
+                        "error": f"execution failed: {e!r}",
+                    }
+                break
             if not doc.get("ok"):
                 failures += 1
         else:
@@ -833,6 +935,8 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
             }
             if entry.get("diagnostics"):
                 doc["diagnostics"] = entry["diagnostics"]
+            if entry.get("shed"):
+                doc["shed"] = True
         out_stream.write(json.dumps(doc) + "\n")
         out_stream.flush()
     return failures

@@ -11,14 +11,25 @@ Records are versioned JSON (STORE_VERSION) written atomically
 truncated record). Loads are corruption-tolerant by contract: any
 unreadable/unparseable/wrong-version/mis-addressed record is a MISS
 (counted as `service_cache_corrupt`), never an exception — the
-executor simply recomputes and overwrites. `tools/check_service_store.py`
-audits and garbage-collects a store offline with the same validation.
+executor simply recomputes and overwrites. A corrupt file is also
+QUARANTINED: atomically renamed to `<fp>.json.corrupt` (counted
+`cache_corrupt_quarantined`), so a record that keeps failing
+validation is parsed once, not on every subsequent hit, and the
+damaged bytes survive for post-mortem while `put` rewrites the live
+address. `tools/check_service_store.py` audits and garbage-collects
+a store offline with the same validation.
+
+Chaos: the disk tier carries the `cache_load` / `cache_store`
+injection sites (runtime/faults.py): a corrupt-kind fault mangles the
+just-parsed record (driving the real quarantine path end to end), a
+raise-kind store fault exercises the degrade-to-memory-only path.
+Both are inert no-ops unless an injector is installed.
 
 Telemetry: `service_cache_hit_mem` / `service_cache_hit_disk` /
 `service_cache_miss` / `service_cache_corrupt` /
-`service_cache_evictions` counters land in the active run, so a serve
-session's JSON export shows its hit ratio next to the engines' own
-dispatch counters.
+`service_cache_corrupt_quarantined` / `service_cache_evictions`
+counters land in the active run, so a serve session's JSON export
+shows its hit ratio next to the engines' own dispatch counters.
 """
 
 from __future__ import annotations
@@ -28,7 +39,7 @@ import json
 import os
 import threading
 
-from ..runtime import telemetry
+from ..runtime import faults, telemetry
 from ..runtime.io import atomic_write_json
 
 # Version of the RESULT RECORD shape (the dict produced by
@@ -130,6 +141,7 @@ class ResultCache:
             out.setdefault("hit_disk", 0)
             out.setdefault("miss", 0)
             out.setdefault("corrupt", 0)
+            out.setdefault("corrupt_quarantined", 0)
             out.setdefault("evictions", 0)
             out.setdefault("write_failed", 0)
             out["mem_entries"] = len(self._mem)
@@ -181,14 +193,28 @@ class ResultCache:
         except FileNotFoundError:
             return None
         except (OSError, ValueError):
-            self._count("corrupt")
-            telemetry.count("service_cache_corrupt")
+            self._corrupt(path)
             return None
+        rec = faults.mangle("cache_load", rec, key=fingerprint)
         if validate_record(rec, fingerprint):
-            self._count("corrupt")
-            telemetry.count("service_cache_corrupt")
+            self._corrupt(path)
             return None
         return rec
+
+    def _corrupt(self, path: str) -> None:
+        """Count one corrupt record and quarantine the file: an atomic
+        rename to `*.corrupt` so the bad bytes are (a) never re-parsed
+        on the next lookup — the address misses cleanly until `put`
+        rewrites it — and (b) preserved for offline post-mortem
+        (tools/check_service_store.py reports them as stray files)."""
+        self._count("corrupt")
+        telemetry.count("service_cache_corrupt")
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            return
+        self._count("corrupt_quarantined")
+        telemetry.count("service_cache_corrupt_quarantined")
 
     # -- store --------------------------------------------------------
 
@@ -199,10 +225,12 @@ class ResultCache:
             path = self.path_for(fingerprint)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             try:
+                faults.fire("cache_store", key=fingerprint)
                 atomic_write_json(path, record)
-            except OSError:
-                # a full/readonly disk degrades to memory-only serving;
-                # the result itself still reaches the caller
+            except (OSError, faults.FaultInjected):
+                # a full/readonly disk (or an injected store fault)
+                # degrades to memory-only serving; the result itself
+                # still reaches the caller
                 self._count("write_failed")
                 telemetry.count("service_cache_write_failed")
 

@@ -40,6 +40,32 @@ query":
   persisted to the cache. max_workers is clamped UP to the replica
   count — fewer pool threads than replicas would strand replicas
   idle with work queued behind busy ones.
+- **Resilience (config.py::ResilienceConfig).** Four layers, all
+  off/neutral by default and all pure serving policy (never in the
+  fingerprint; retried/hedged results are seed-derived and therefore
+  bit-identical — tools/check_chaos.py pins it):
+  * per-attempt timeouts + bounded retry with deterministic seeded
+    exponential backoff (runtime/faults.py::backoff_delay — jitter
+    from a counter hash, never the wall clock);
+  * hedged dispatch: a routed execution still unresolved after
+    `hedge_after_s` is duplicated onto a second replica; first result
+    wins, the still-queued loser is cancelled
+    (`service_hedged`/`service_hedge_wins`);
+  * per-engine circuit breakers (service/breakers.py) with half-open
+    probation: a repeatedly-failing engine is skipped cheaply down
+    the degrade chain (`service_breaker_open_skips`) until a probe
+    re-closes it — the replica pool runs the same state machine per
+    replica;
+  * admission control: with a `queue_limit`, a submit that would
+    queue past its priority class's share is SHED at the gate —
+    a structured `shed: true` outcome in microseconds instead of a
+    deadline timeout after seconds of queueing (`service_shed`).
+  Every outcome (retried/hedged/shed/broken-open) is counted on all
+  three counter surfaces and stamped on the request's ledger row.
+- **Chaos.** Engine attempts pass the `engine_execute` fault-
+  injection site (runtime/faults.py) — a no-op unless a chaos spec is
+  installed, so the default path stays zero-overhead and
+  bit-identical.
 
 The engine table and the runner hook are module-level / constructor
 injection points so tests can wrap them (e.g. add a barrier to force
@@ -51,19 +77,28 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import math
 import threading
 import time
 import uuid
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    Future,
+    ThreadPoolExecutor,
+    TimeoutError as FuturesTimeoutError,
+    wait as futures_wait,
+)
 
 from ..config import (
-    BatchConfig, MachineConfig, ReplicaConfig, SamplerConfig,
+    BatchConfig, MachineConfig, ReplicaConfig, ResilienceConfig,
+    SamplerConfig,
 )
 from ..ir import Program
-from ..runtime import report, telemetry
+from ..runtime import faults, report, telemetry
 from ..runtime.aet import aet_mrc
 from ..runtime.cri import cri_distribute
 from ..runtime.obs import ledger as obs_ledger
+from .breakers import CircuitBreaker
 from .cache import STORE_VERSION, ResultCache
 from .replicas import ReplicaPool
 
@@ -87,6 +122,17 @@ SERVICE_ENGINES = (
 
 def degrade_chain(engine: str) -> tuple[str, ...]:
     return DEGRADE_CHAINS.get(engine, (engine,))
+
+
+class _AttemptTimeout(Exception):
+    """Internal: one chain attempt overran its per-attempt budget."""
+
+
+# Priority classes and the fraction of the admission queue_limit each
+# may fill before it sheds: low-priority work sheds first, high last,
+# so a saturated queue keeps serving its most important traffic.
+PRIORITY_CLASSES = ("low", "normal", "high")
+_PRIORITY_HEADROOM = {"low": 0.5, "normal": 0.75, "high": 1.0}
 
 
 def default_runner(engine: str, program: Program,
@@ -195,6 +241,10 @@ def execute_request(request, program: Program, machine: MachineConfig,
     if span_id is not None:
         attrs["span_id"] = span_id
     with telemetry.span("service_exec", **attrs):
+        # chaos site: one occurrence per attempt of this fingerprint,
+        # so retries/hedges draw fresh (but deterministic) decisions
+        faults.fire("engine_execute", key=fingerprint,
+                    engine=engine, model=program.name)
         res, per_ref = runner(engine, program, machine, request)
         record = build_record(
             request, machine, engine, fingerprint, res, per_ref
@@ -399,18 +449,27 @@ class RequestExecutor:
                  ledger_path: str | None = None,
                  batching: BatchConfig | None = None,
                  batch_runner=default_batch_runner,
-                 replicas: ReplicaConfig | int | None = None):
+                 replicas: ReplicaConfig | int | None = None,
+                 resilience: ResilienceConfig | None = None):
         self.cache = cache if cache is not None else ResultCache()
         self.runner = runner
         self.batch_runner = batch_runner
         self.ledger_path = ledger_path
+        self._resilience = (
+            resilience if resilience is not None else ResilienceConfig()
+        )
+        self._draining = False
+        # per-engine circuit breakers, created lazily on first attempt
+        self._breakers: dict[str, CircuitBreaker] = {}
         self._replicas: ReplicaPool | None = None
         if replicas is not None:
             cfg = (
                 replicas if isinstance(replicas, ReplicaConfig)
                 else ReplicaConfig(count=replicas)
             )
-            self._replicas = ReplicaPool(cfg)
+            self._replicas = ReplicaPool(
+                cfg, resilience=self._resilience
+            )
             n = len(self._replicas)
             if max_workers < n:
                 # fewer pool threads than replicas silently strands
@@ -477,7 +536,10 @@ class RequestExecutor:
                     "ledger_rows", "ledger_write_failed",
                     "batches_formed", "batch_members",
                     "batch_fallback_solo", "preflight_rejected",
-                    "frontend_rejected", "race_warnings"):
+                    "frontend_rejected", "race_warnings",
+                    "shed", "retried", "hedged", "hedge_wins",
+                    "hedge_cancelled", "breaker_opened",
+                    "breaker_reclosed", "breaker_open_skips"):
             out.setdefault(key, 0)
         active = out.pop("active")
         out["in_flight"] = inflight
@@ -507,6 +569,14 @@ class RequestExecutor:
             # same counts /metrics exports (requests_routed_r*) and
             # check_ledger --stats aggregates (rows' replica_id)
             out["replicas"] = self._replicas.snapshot()
+        out["draining"] = self._draining
+        out["queue_limit"] = self._resilience.queue_limit
+        with self._lock:
+            brs = dict(self._breakers)
+        if brs:
+            out["breakers"] = {
+                eng: br.snapshot() for eng, br in sorted(brs.items())
+            }
         return out
 
     def _note_latency(self, outcome: dict, batched: bool) -> None:
@@ -541,6 +611,14 @@ class RequestExecutor:
         "preflight_rejected": "ir_preflight_failures",
         "frontend_rejected": "frontend_rejected",
         "race_warnings": "race_warnings",
+        "shed": "service_shed",
+        "retried": "service_retried",
+        "hedged": "service_hedged",
+        "hedge_wins": "service_hedge_wins",
+        "hedge_cancelled": "service_hedge_cancelled",
+        "breaker_opened": "service_breaker_opened",
+        "breaker_reclosed": "service_breaker_reclosed",
+        "breaker_open_skips": "service_breaker_open_skips",
     }
 
     def _count(self, key: str, inc: int = 1) -> None:
@@ -587,6 +665,37 @@ class RequestExecutor:
                 # joiners ride the executing request's ledger row —
                 # remembered per fingerprint so the row can report how
                 # many submissions it answered
+                self._coalesced_by_fp[fingerprint] += 1
+                telemetry.count("service_coalesced")
+                return fut
+            # admission gate — AFTER the coalesce join (joining an
+            # in-flight execution costs nothing, so it is never shed)
+            # and BEFORE any queue/pool state is touched, so a shed
+            # is a cheap structured refusal, not an expensive timeout
+            shed_reason = None
+            priority = getattr(request, "priority", "normal")
+            if self._draining:
+                shed_reason = "service draining (shutdown in progress)"
+            elif (self._resilience.queue_limit is not None
+                    and self._resilience.shed_enabled):
+                depth = (len(self._inflight)
+                         - self._stats.get("active", 0))
+                limit = self._admission_limit(priority)
+                if depth >= limit:
+                    shed_reason = (
+                        f"queue depth {depth} at admission limit "
+                        f"{limit} for priority {priority!r}"
+                    )
+        if shed_reason is not None:
+            return self._shed(request, fingerprint, shed_reason,
+                              preflight, submitted_at)
+        with self._lock:
+            # re-check the singleflight join: the gate ran outside
+            # the first critical section, so an identical fingerprint
+            # may have landed in between
+            fut = self._inflight.get(fingerprint)
+            if fut is not None:
+                self._stats["coalesced"] += 1
                 self._coalesced_by_fp[fingerprint] += 1
                 telemetry.count("service_coalesced")
                 return fut
@@ -638,6 +747,79 @@ class RequestExecutor:
         any mix of models/N/configs mergeable within it."""
         return request.engine == "sampled"
 
+    def _admission_limit(self, priority: str) -> int:
+        """Queue slots this priority class may fill before shedding
+        (a fraction of queue_limit; high priority gets the full
+        limit, so under saturation low-priority traffic sheds
+        first)."""
+        frac = _PRIORITY_HEADROOM.get(
+            priority, _PRIORITY_HEADROOM["normal"]
+        )
+        return max(1, math.ceil(self._resilience.queue_limit * frac))
+
+    def _shed(self, request, fingerprint: str, reason: str,
+              preflight, submitted_at: float) -> Future:
+        """Refuse one submission at the admission gate with a
+        STRUCTURED outcome, never an exception: counted `shed` (not
+        `failed` — the service declined the work, it did not botch
+        it), stamped on its own ledger row, and resolved in
+        microseconds instead of timing out after seconds of
+        queueing."""
+        self._count("shed")
+        telemetry.event(
+            "service_shed", fingerprint=fingerprint, reason=reason,
+            priority=getattr(request, "priority", "normal"),
+        )
+        outcome = {
+            "record": None,
+            "cache": None,
+            "degraded": [],
+            "error": f"shed: {reason}",
+            "shed": True,
+            "latency_s": round(time.perf_counter() - submitted_at, 6),
+            "mrc_digest": None,
+            "trace_id": getattr(request, "trace_id", None),
+            "span_id": None,
+            "queue_s": None,
+            "execute_s": None,
+            "replica_id": None,
+            "preflight": preflight,
+        }
+        self._record_flight(request, outcome, extra={"shed": True})
+        if self.ledger_path:
+            self._append_ledger_row(
+                request, fingerprint, outcome,
+                telemetry.compile_counters_snapshot(),
+            )
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        fut.set_result(outcome)
+        return fut
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Begin graceful shutdown: every LATER submit sheds at the
+        admission gate, and work still queued in the pool (submitted
+        but not yet executing) is cancelled — its waiters observe
+        CancelledError, which the serve loop answers with a structured
+        shed response. Executions already running finish normally:
+        this drains the service, it does not abort it."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+            pending = list(self._inflight.values())
+        if already:
+            return
+        telemetry.event("service_draining")
+        for fut in pending:
+            # queued pool futures cancel; executing (and batch-window)
+            # futures refuse and resolve normally during the drain
+            if fut.cancel():
+                self._count("shed")
+
     def shutdown(self) -> None:
         if self._batcher is not None:
             # flush the admission window through the pool BEFORE the
@@ -651,16 +833,57 @@ class RequestExecutor:
 
     # -- replica routing ----------------------------------------------
 
-    def _execute_routed(self, fn, trace_id=None, members: int = 1):
+    def _execute_routed(self, fn, trace_id=None, members: int = 1,
+                        meta: dict | None = None):
         """Run one engine execution (a solo chain attempt or a whole
         batch window) on the replica pool when one exists, inline
         otherwise. Returns (fn's result, replica_id|None, re-route
-        degradation events)."""
+        degradation events).
+
+        Hedging: with `hedge_after_s` configured and >= 2 replicas, a
+        routed dispatch still unresolved after the hedge delay is
+        duplicated onto a second replica (tail-latency insurance
+        against a straggler). First result wins; the losing copy is
+        cancelled while still queued (ReplicaPool.try_cancel) or, if
+        already executing, finishes into the void. Both copies compute
+        the same seed-derived bytes, so whichever wins the response is
+        bit-identical — hedging can only change WHEN the answer
+        arrives, never WHAT it is."""
         if self._replicas is None:
             return fn(), None, []
-        return self._replicas.run(
+        hedge_s = self._resilience.hedge_after_s
+        if hedge_s is None or len(self._replicas) < 2:
+            return self._replicas.run(
+                fn, trace_id=trace_id, members=members
+            )
+        primary = self._replicas.submit(
             fn, trace_id=trace_id, members=members
         )
+        try:
+            return primary.result(timeout=hedge_s)
+        except FuturesTimeoutError:
+            pass
+        self._count("hedged")
+        if meta is not None:
+            meta["hedged"] = True
+        telemetry.event("service_hedged", trace_id=trace_id,
+                        hedge_after_s=hedge_s)
+        hedge = self._replicas.submit(
+            fn, trace_id=trace_id, members=members
+        )
+        futures_wait((primary, hedge), return_when=FIRST_COMPLETED)
+        winner, loser = (
+            (primary, hedge) if primary.done() else (hedge, primary)
+        )
+        if winner is hedge:
+            self._count("hedge_wins")
+        if self._replicas.try_cancel(loser):
+            self._count("hedge_cancelled")
+        else:
+            # the loser is executing (or finished) — let it resolve in
+            # the background so its replica bookkeeping stays honest
+            loser.add_done_callback(lambda f: f.exception())
+        return winner.result()
 
     def _absorb_replica_events(self, degraded: list, events,
                                fingerprint: str) -> None:
@@ -721,6 +944,7 @@ class RequestExecutor:
                 degraded: list[dict] = []
                 error = None
                 replica_id = None
+                meta = {"retries": 0, "hedged": False}
                 if record is None:
                     span_id = uuid.uuid4().hex[:16]
                     exec_t0 = time.perf_counter()
@@ -728,6 +952,7 @@ class RequestExecutor:
                         self._run_chain(
                             request, program, machine, fingerprint,
                             trace_id=trace_id, span_id=span_id,
+                            meta=meta,
                         )
                     )
                     execute_s = time.perf_counter() - exec_t0
@@ -754,6 +979,8 @@ class RequestExecutor:
             "execute_s": execute_s,
             "replica_id": replica_id,
             "preflight": preflight,
+            "retries": meta["retries"],
+            "hedged": meta["hedged"],
         }
         self._observe_stages(outcome, queue_s=queue_s,
                              execute_s=execute_s, fetch_s=fetch_s)
@@ -923,12 +1150,13 @@ class RequestExecutor:
                     (e.request, e.program, e.machine) for e in runnable
                 ])
 
+        meta = {"retries": 0, "hedged": False}
         try:
             exec_t0 = time.perf_counter()
             outs, batch_rid, batch_events = self._execute_routed(
                 _run_window,
                 trace_id=getattr(runnable[0].request, "trace_id", None),
-                members=len(runnable),
+                members=len(runnable), meta=meta,
             )
             execute_s = time.perf_counter() - exec_t0
             telemetry.count("service_exec_done")
@@ -987,6 +1215,8 @@ class RequestExecutor:
                 # the replica that ultimately served the window (the
                 # re-route target when quarantine moved it)
                 "replica_id": batch_rid,
+                # a hedged window marks every member it carried
+                "hedged": meta["hedged"],
             }
             self._observe_stages(
                 outcome, queue_s=outcome["queue_s"],
@@ -1017,10 +1247,11 @@ class RequestExecutor:
         trace_id = getattr(e.request, "trace_id", None)
         span_id = uuid.uuid4().hex[:16]
         exec_t0 = time.perf_counter()
+        meta = {"retries": 0, "hedged": False}
         try:
             record, degraded, error, replica_id = self._run_chain(
                 e.request, e.program, e.machine, e.fingerprint,
-                trace_id=trace_id, span_id=span_id,
+                trace_id=trace_id, span_id=span_id, meta=meta,
             )
             if record is not None and not degraded:
                 self.cache.put(e.fingerprint, record)
@@ -1045,6 +1276,8 @@ class RequestExecutor:
             "batch_wait_s": self._batch_wait_s(e),
             "execute_s": execute_s,
             "replica_id": replica_id,
+            "retries": meta["retries"],
+            "hedged": meta["hedged"],
         }
         self._observe_stages(
             outcome, batch_wait_s=outcome["batch_wait_s"],
@@ -1170,6 +1403,15 @@ class RequestExecutor:
                 # signature, so a model:"custom" row is attributable
                 # to a nest shape without replaying the document
                 row["signature"] = pf["signature"]
+        # schema-v2 resilience outcomes: only stamped when they
+        # happened, so pre-resilience rows and quiet requests keep the
+        # exact same shape (and bytes) as before
+        if outcome.get("shed"):
+            row["shed"] = True
+        if outcome.get("hedged"):
+            row["hedged"] = True
+        if outcome.get("retries"):
+            row["retries"] = int(outcome["retries"])
         for stage in ("queue_s", "batch_wait_s", "execute_s"):
             v = outcome.get(stage)
             if v is not None:
@@ -1188,13 +1430,42 @@ class RequestExecutor:
         except Exception:
             self._count("ledger_write_failed")
 
+    def _breaker(self, engine: str) -> CircuitBreaker:
+        """The lazily-created per-engine circuit breaker."""
+        with self._lock:
+            br = self._breakers.get(engine)
+            if br is None:
+                r = self._resilience
+                br = CircuitBreaker(
+                    failures=r.breaker_failures,
+                    probation_s=r.breaker_probation_s,
+                    escalation=r.breaker_escalation,
+                    probation_max_s=r.breaker_probation_max_s,
+                )
+                self._breakers[engine] = br
+            return br
+
     def _run_chain(self, request, program, machine, fingerprint,
                    trace_id: str | None = None,
-                   span_id: str | None = None):
+                   span_id: str | None = None,
+                   meta: dict | None = None):
         """Walk the degradation chain under the request deadline.
         Returns (record|None, degraded events, error|None,
         replica_id|None — the replica that served the successful
-        attempt)."""
+        attempt). `meta` collects resilience bookkeeping (retries,
+        hedged) for the outcome/ledger row.
+
+        Per engine: the circuit breaker gates the attempt (open =
+        skip down the chain for free), then up to 1 + max_retries
+        attempts run under the per-attempt budget — the request
+        deadline on non-final engines (the pre-resilience behavior),
+        tightened everywhere by the opt-in attempt_timeout_s. Retry
+        backoff is deterministic (runtime/faults.py::backoff_delay —
+        seeded jitter keyed by (fingerprint, engine, attempt), so a
+        chaos replay waits the same milliseconds). An attempt TIMEOUT
+        never trips the breaker: the abandoned thread may still be
+        computing a perfectly good answer; only raised failures
+        count."""
         chain = degrade_chain(request.engine)
         deadline = (
             None if request.deadline_s is None
@@ -1202,6 +1473,7 @@ class RequestExecutor:
         )
         degraded: list[dict] = []
         last_error = None
+        res = self._resilience
         for i, engine in enumerate(chain):
             is_last = i == len(chain) - 1
             remaining = (
@@ -1216,50 +1488,132 @@ class RequestExecutor:
                     "deadline exhausted before attempt",
                 )
                 continue
-            try:
-                if remaining is None or is_last:
-                    # no budget to enforce (or nothing to fall back
-                    # to): run on this worker (or its routed replica)
-                    record, rid, events = self._execute_routed(
-                        lambda eng=engine: execute_request(
-                            request, program, machine, eng,
-                            fingerprint, self.runner,
-                            trace_id=trace_id, span_id=span_id,
-                        ),
-                        trace_id=trace_id,
-                    )
-                    self._absorb_replica_events(
-                        degraded, events, fingerprint
-                    )
-                    return record, degraded, None, rid
-                hit = self._attempt_with_timeout(
-                    request, program, machine, engine, fingerprint,
-                    remaining, trace_id=trace_id, span_id=span_id,
-                )
-                if hit is not None:
-                    record, rid, events = hit
-                    self._absorb_replica_events(
-                        degraded, events, fingerprint
-                    )
-                    return record, degraded, None, rid
-                self._note_degrade(
-                    degraded, fingerprint, engine, chain[i + 1],
-                    f"deadline {request.deadline_s}s overrun",
-                )
-            except Exception as e:
-                last_error = repr(e)
-                telemetry.count("service_exec_failed")
+            br = self._breaker(engine)
+            if not br.allow():
+                # fail fast past a repeatedly-failing engine: no
+                # attempt budget burned, no side thread spawned
+                self._count("breaker_open_skips")
+                telemetry.event("service_breaker_open_skip",
+                                engine=engine, fingerprint=fingerprint)
+                reason = f"engine {engine!r} circuit breaker open"
                 if is_last:
-                    return None, degraded, last_error, None
+                    return None, degraded, last_error or reason, None
                 self._note_degrade(
-                    degraded, fingerprint, engine, chain[i + 1],
-                    f"engine failed: {last_error[:200]}",
+                    degraded, fingerprint, engine, chain[i + 1], reason
                 )
+                continue
+            attempt = 0
+            fail_reason = None
+            while True:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.perf_counter()
+                )
+                if (remaining is not None and remaining <= 0
+                        and not is_last):
+                    fail_reason = (
+                        f"deadline {request.deadline_s}s overrun"
+                    )
+                    break
+                budget = (
+                    remaining
+                    if remaining is not None and not is_last
+                    else None
+                )
+                if res.attempt_timeout_s is not None:
+                    budget = (
+                        res.attempt_timeout_s if budget is None
+                        else min(budget, res.attempt_timeout_s)
+                    )
+                # which bound would an overrun have hit? the request
+                # deadline means degrade (retrying cannot help); the
+                # attempt timeout means the attempt was slow and a
+                # retry may land on a healthier replica
+                deadline_limited = (
+                    remaining is not None
+                    and not is_last
+                    and (budget is None or budget >= remaining)
+                )
+                try:
+                    if budget is None:
+                        record, rid, events = self._execute_routed(
+                            lambda eng=engine: execute_request(
+                                request, program, machine, eng,
+                                fingerprint, self.runner,
+                                trace_id=trace_id, span_id=span_id,
+                            ),
+                            trace_id=trace_id, meta=meta,
+                        )
+                    else:
+                        hit = self._attempt_with_timeout(
+                            request, program, machine, engine,
+                            fingerprint, budget, trace_id=trace_id,
+                            span_id=span_id, meta=meta,
+                        )
+                        if hit is None:
+                            raise _AttemptTimeout()
+                        record, rid, events = hit
+                except _AttemptTimeout:
+                    if deadline_limited:
+                        fail_reason = (
+                            f"deadline {request.deadline_s}s overrun"
+                        )
+                        break
+                    last_error = fail_reason = (
+                        f"attempt timeout {res.attempt_timeout_s}s "
+                        f"overrun on {engine!r}"
+                    )
+                except Exception as e:
+                    last_error = repr(e)
+                    fail_reason = f"engine failed: {last_error[:200]}"
+                    telemetry.count("service_exec_failed")
+                    if br.failure():
+                        self._count("breaker_opened")
+                        telemetry.event(
+                            "service_breaker_opened", engine=engine,
+                            fingerprint=fingerprint,
+                        )
+                else:
+                    if br.success():
+                        self._count("breaker_reclosed")
+                        telemetry.event(
+                            "service_breaker_reclosed", engine=engine
+                        )
+                    self._absorb_replica_events(
+                        degraded, events, fingerprint
+                    )
+                    return record, degraded, None, rid
+                if attempt >= res.max_retries:
+                    break
+                delay = faults.backoff_delay(
+                    attempt, res.backoff_base_s, res.backoff_max_s,
+                    res.backoff_seed, fingerprint, engine,
+                )
+                if deadline is not None and (
+                    deadline - time.perf_counter() - delay <= 0
+                ):
+                    break  # no budget left to retry into
+                time.sleep(delay)
+                attempt += 1
+                self._count("retried")
+                if meta is not None:
+                    meta["retries"] = meta.get("retries", 0) + 1
+            if is_last:
+                return (
+                    None, degraded,
+                    last_error or fail_reason or "no engine attempted",
+                    None,
+                )
+            self._note_degrade(
+                degraded, fingerprint, engine, chain[i + 1],
+                fail_reason or "engine failed",
+            )
         return None, degraded, last_error or "no engine attempted", None
 
     def _attempt_with_timeout(self, request, program, machine, engine,
                               fingerprint, budget_s: float,
-                              trace_id=None, span_id=None):
+                              trace_id=None, span_id=None,
+                              meta: dict | None = None):
         """Run one attempt in a side thread and wait at most budget_s.
         None = overrun (the attempt thread is abandoned; Python offers
         no preemption, so its work completes unobserved). On success
@@ -1274,7 +1628,7 @@ class RequestExecutor:
                         fingerprint, self.runner,
                         trace_id=trace_id, span_id=span_id,
                     ),
-                    trace_id=trace_id,
+                    trace_id=trace_id, meta=meta,
                 )
             except Exception as e:
                 box["error"] = e

@@ -21,19 +21,31 @@ whose own queue is empty STEALS the oldest stealable item from the
 longest peer queue (`windows_stolen`), so one slow request cannot
 strand queued work behind it.
 
-Failure quarantine: a replica whose execution raises is quarantined —
-removed from routing, its queue drained onto healthy peers — and the
-failing item is re-routed ONCE to the least-loaded healthy replica,
-recorded as a degradation event (`{"from": "replica:K", ...}` in the
-request's degrade chain, a `replica_quarantined` telemetry event, and
-the completion counted `service_degraded` — so PR 9's live registry
-windows and the SLO sentinel's error-budget objective both see it).
-A re-routed item that fails AGAIN is attributed to the work, not the
-replica: the second replica is NOT quarantined and the exception
-propagates to the executor's normal engine-degradation handling.
-When every replica is quarantined, routing falls back to the full
-set — a degraded pool still serves best-effort rather than going
-dark.
+Failure breakers: a replica whose execution raises has its per-
+replica circuit breaker OPENED (the service/breakers.py state
+machine, embedded here under the pool's condition lock) — removed
+from routing for a probation window, its queue drained onto healthy
+peers — and the failing item is re-routed ONCE to the least-loaded
+healthy replica, recorded as a degradation event (`{"from":
+"replica:K", ...}` in the request's degrade chain, a
+`replica_quarantined` telemetry event, and the completion counted
+`service_degraded` — so PR 9's live registry windows and the SLO
+sentinel's error-budget objective both see it). A re-routed item
+that fails AGAIN is attributed to the work, not the replica: the
+second replica is NOT opened and the exception propagates to the
+executor's normal engine-degradation handling.
+
+Unlike PR 10's one-shot quarantine, an open replica RECOVERS: once
+its probation elapses the router hands it exactly one work item as a
+half-open probe. Probe success re-closes the breaker
+(`replica_breaker_reclosed` — the replica rejoins routing with full
+standing); probe failure re-opens it with the probation escalated.
+When every replica is open, routing falls back to the full set — a
+degraded pool still serves best-effort rather than going dark.
+
+Chaos: each worker pickup passes the `replica_dispatch` injection
+site (runtime/faults.py), so tools/check_chaos.py can drive the
+open/probe/re-close cycle deterministically.
 
 Placement is pure routing (parallel/placement.py): the per-ref sample
 streams are seed-derived, never device-derived, so MRC bytes are
@@ -48,8 +60,8 @@ import threading
 import time
 from concurrent.futures import Future
 
-from ..config import ReplicaConfig, SamplerConfig
-from ..runtime import telemetry
+from ..config import ReplicaConfig, ResilienceConfig, SamplerConfig
+from ..runtime import faults, telemetry
 
 
 def current_replica_id():
@@ -61,11 +73,12 @@ def current_replica_id():
 
 
 class Replica:
-    """One device group + queue + counters. All mutable state is
-    guarded by the owning pool's condition lock."""
+    """One device group + queue + counters + breaker state. All
+    mutable state is guarded by the owning pool's condition lock."""
 
     __slots__ = (
-        "rid", "devices", "mesh", "queue", "busy", "quarantined",
+        "rid", "devices", "mesh", "queue", "busy", "state",
+        "reopen_at", "probation_s", "reclosed",
         "quarantine_reason", "routed", "served", "stolen", "completed",
         "failed", "warmed",
     )
@@ -76,7 +89,13 @@ class Replica:
         self.mesh = mesh
         self.queue: collections.deque = collections.deque()
         self.busy = False
-        self.quarantined = False
+        # per-replica breaker: "closed" | "open" | "half_open"
+        # (service/breakers.py semantics, embedded under the pool
+        # lock so routing and transitions are one atomic step)
+        self.state = "closed"
+        self.reopen_at = 0.0  # monotonic instant probation ends
+        self.probation_s = 0.0  # current (possibly escalated) window
+        self.reclosed = 0  # successful half-open probes
         self.quarantine_reason: str | None = None
         self.routed = 0  # work items routed here at submit
         self.served = 0  # requests whose execution completed here
@@ -84,6 +103,11 @@ class Replica:
         self.completed = 0  # work items finished OK here
         self.failed = 0  # work items that raised here
         self.warmed: set = set()  # structure digests warmed here
+
+    @property
+    def quarantined(self) -> bool:
+        """Out of normal routing (breaker open or probing)."""
+        return self.state != "closed"
 
 
 class _Work:
@@ -107,13 +131,18 @@ class ReplicaPool:
     work stealing, and failure quarantine."""
 
     def __init__(self, config: ReplicaConfig | None = None,
-                 devices=None):
+                 devices=None,
+                 resilience: ResilienceConfig | None = None):
         import jax
 
         from ..parallel.mesh import build_mesh
 
         devs = list(devices) if devices is not None else jax.devices()
         cfg = config or ReplicaConfig()
+        res = resilience or ResilienceConfig()
+        self._probation_s = res.breaker_probation_s
+        self._escalation = res.breaker_escalation
+        self._probation_max_s = res.breaker_probation_max_s
         k = cfg.resolve(len(devs))
         # contiguous near-equal groups: the first (len % k) replicas
         # take one extra device
@@ -216,6 +245,7 @@ class ReplicaPool:
         """Per-replica occupancy for serve `stats` (the instance-local
         view; `/metrics` and the ledger aggregate report the same
         counts under requests_routed_r*/replica_id)."""
+        now = time.monotonic()
         with self._cv:
             reps = [
                 {
@@ -229,9 +259,16 @@ class ReplicaPool:
                     "completed": r.completed,
                     "failed": r.failed,
                     "quarantined": r.quarantined,
+                    "breaker": r.state,
+                    "breaker_reclosed": r.reclosed,
                     **(
                         {"quarantine_reason": r.quarantine_reason}
                         if r.quarantined else {}
+                    ),
+                    **(
+                        {"reopen_in_s": round(
+                            max(0.0, r.reopen_at - now), 3)}
+                        if r.state == "open" else {}
                     ),
                 }
                 for r in self.replicas
@@ -263,9 +300,20 @@ class ReplicaPool:
 
     def _route_locked(self) -> Replica:
         """Least-loaded live replica (queue + executing), round-robin
-        among ties. All-quarantined pools route across the full set:
-        best-effort beats going dark."""
-        live = [r for r in self.replicas if not r.quarantined]
+        among ties. An OPEN replica whose probation has elapsed is
+        promoted to half_open and takes this one work item as its
+        probe (success re-closes it in _execute; failure re-opens
+        escalated in _handle_failure). All-open pools route across
+        the full set: best-effort beats going dark."""
+        now = time.monotonic()
+        for r in self.replicas:
+            if r.state == "open" and now >= r.reopen_at:
+                r.state = "half_open"
+                telemetry.count("replica_breaker_half_open")
+                telemetry.event("replica_breaker_half_open",
+                                replica=r.rid)
+                return r
+        live = [r for r in self.replicas if r.state == "closed"]
         if not live:
             live = self.replicas
         load = lambda r: len(r.queue) + (1 if r.busy else 0)
@@ -273,6 +321,22 @@ class ReplicaPool:
         ties = [r for r in live if load(r) == best]
         self._rr += 1
         return ties[self._rr % len(ties)]
+
+    def try_cancel(self, future) -> bool:
+        """Remove a still-QUEUED work item by its future (the hedging
+        loser: the executor submits a duplicate to a second replica
+        and cancels whichever copy has not started when the first
+        result lands). True when the item was found and removed; False
+        means it is executing (or done) and will resolve normally."""
+        with self._cv:
+            for r in self.replicas:
+                for w in r.queue:
+                    if w.future is future:
+                        r.queue.remove(w)
+                        self._gauges_locked()
+                        telemetry.count("replica_work_cancelled")
+                        return True
+        return False
 
     def _gauges_locked(self) -> None:
         busy = sum(1 for r in self.replicas if r.busy)
@@ -329,6 +393,8 @@ class ReplicaPool:
 
         t0 = time.perf_counter()
         try:
+            faults.fire("replica_dispatch", key=work.trace_id,
+                        replica=replica.rid)
             with placement.device_scope(
                 replica.devices, mesh=replica.mesh,
                 replica_id=replica.rid,
@@ -338,9 +404,24 @@ class ReplicaPool:
             self._handle_failure(replica, work, exc)
             return
         dt = time.perf_counter() - t0
+        reclosed = False
         with self._cv:
             replica.completed += 1
             replica.served += work.members
+            if replica.state != "closed":
+                # successful half-open probe (or a pinned/stolen item
+                # that completed here): the breaker re-closes and the
+                # replica rejoins routing with full standing
+                replica.state = "closed"
+                replica.quarantine_reason = None
+                replica.probation_s = self._probation_s
+                replica.reclosed += 1
+                reclosed = True
+                self._cv.notify_all()
+        if reclosed:
+            telemetry.count("replica_breaker_reclosed")
+            telemetry.event("replica_breaker_reclosed",
+                            replica=replica.rid)
         telemetry.count(f"requests_routed_r{replica.rid}",
                         work.members)
         if obs_metrics.get() is not None:
@@ -352,22 +433,39 @@ class ReplicaPool:
 
     def _handle_failure(self, replica: Replica, work: _Work,
                         exc: Exception) -> None:
-        """Quarantine the replica and re-route the item once; a second
-        failure (or nowhere to go) propagates to the caller."""
+        """Open the replica's breaker (or re-open it escalated after
+        a failed half-open probe) and re-route the item once; a
+        second failure (or nowhere to go) propagates to the caller."""
         reason = repr(exc)[:200]
         drained: list[_Work] = []
         target = None
+        probe_failed = False
         with self._cv:
             replica.failed += 1
             if (work.attempts == 0 and not work.pinned
                     and not self._closed):
                 peers = [r for r in self.replicas
-                         if r is not replica and not r.quarantined]
+                         if r is not replica
+                         and r.state == "closed"]
                 if peers:
-                    if not replica.quarantined:
-                        replica.quarantined = True
+                    if replica.state == "half_open":
+                        # failed probe: back to open, probation
+                        # escalated (capped) — a flapping replica
+                        # gets probed less and less often
+                        probe_failed = True
+                        replica.probation_s = min(
+                            replica.probation_s * self._escalation,
+                            self._probation_max_s,
+                        )
+                    elif replica.state == "closed":
+                        replica.probation_s = self._probation_s
+                    if replica.state != "open":
+                        replica.state = "open"
+                        replica.reopen_at = (
+                            time.monotonic() + replica.probation_s
+                        )
                         replica.quarantine_reason = reason
-                        # strand nothing behind a quarantined replica:
+                        # strand nothing behind an opened replica:
                         # its queued, unpinned items re-route too
                         drained = [w for w in replica.queue
                                    if not w.pinned]
@@ -393,5 +491,6 @@ class ReplicaPool:
         telemetry.event(
             "replica_quarantined", replica=replica.rid,
             rerouted_to=target.rid, drained=len(drained),
-            reason=reason,
+            reason=reason, probe_failed=probe_failed,
+            probation_s=round(replica.probation_s, 3),
         )

@@ -21,12 +21,17 @@ import os
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
 
 from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
-from pluss_sampler_optimization_tpu.config import ReplicaConfig, SLOConfig
+from pluss_sampler_optimization_tpu.config import (
+    ReplicaConfig,
+    ResilienceConfig,
+    SLOConfig,
+)
 from pluss_sampler_optimization_tpu.runtime import telemetry
 from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
 from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
@@ -407,6 +412,47 @@ def test_second_failure_propagates_to_engine_chain(tmp_path):
     assert not resp.ok and "injected replica fault" in resp.error
     assert snap["quarantined"] == 1  # only the FIRST replica
     assert ok.ok
+
+
+def test_broken_replica_recovers_after_probation(tmp_path):
+    """ISSUE-14: the one-shot quarantine is now a circuit breaker. A
+    replica opened by an execution fault leaves routing only for its
+    probation window; the next route after probation is its half-open
+    probe, probe success re-closes the breaker, and everything the
+    recovered replica serves is bit-identical to solo."""
+    res = ResilienceConfig(breaker_probation_s=0.25)
+    tele = telemetry.enable()
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store"), replicas=2,
+        runner=_flaky_runner(1), resilience=res,
+    ) as svc:
+        first = svc.analyze(_sampled_req(seed=21), timeout=300)
+        snap_open = svc.stats()["executor"]["replicas"]
+        time.sleep(0.3)  # probation elapses; next route is the probe
+        after = [svc.analyze(_sampled_req(seed=22 + k), timeout=300)
+                 for k in range(3)]
+        snap_closed = svc.stats()["executor"]["replicas"]
+    telemetry.disable()
+
+    assert first.ok and first.degraded  # the fault re-routed, ok
+    assert snap_open["quarantined"] == 1
+    (opened,) = [r for r in snap_open["replicas"] if r["quarantined"]]
+    assert opened["breaker"] == "open"
+    assert opened["reopen_in_s"] <= 0.25
+
+    assert all(r.ok for r in after)
+    for k, resp in enumerate(after):
+        assert np.asarray(resp.mrc).tobytes() == \
+            _solo_mrc(_sampled_req(seed=22 + k)).tobytes()
+    # probe success re-closed the breaker: nothing is quarantined and
+    # the recovered replica is back with `reclosed` standing
+    assert snap_closed["quarantined"] == 0
+    rec = [r for r in snap_closed["replicas"]
+           if r["replica_id"] == opened["replica_id"]][0]
+    assert rec["breaker"] == "closed" and rec["breaker_reclosed"] >= 1
+    assert rec["completed"] > opened["completed"]  # it served again
+    assert tele.counters.get("replica_breaker_half_open") == 1
+    assert tele.counters.get("replica_breaker_reclosed") == 1
 
 
 # -- max-workers clamp (satellite 3) ----------------------------------

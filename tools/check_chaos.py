@@ -1,0 +1,505 @@
+"""Seeded chaos gate for the hardened serving stack.
+
+Fault injection without a gate is a demo, not a test. This checker
+arms runtime/faults.py with known seeds and asserts the properties
+the resilience layer exists to provide:
+
+  resolve-once   every submitted request resolves exactly once —
+                 ok, failed, or shed — never lost, never doubled
+  bit-identity   every success under chaos (retried, hedged, served
+                 after cache corruption) carries the SAME MRC digest
+                 as the fault-free baseline run of the same request
+  replay         a chaos run is a pure function of (seed, spec):
+                 running it twice yields the same fault counts, the
+                 same per-request ok map, the same digests
+  quarantine     corrupted disk records are renamed *.corrupt,
+                 counted, and transparently recomputed
+  timeouts       a hung attempt is abandoned at the per-attempt
+                 budget and the seeded-backoff retry serves the
+                 request bit-identically
+  breakers       consecutive failures open a breaker (later requests
+                 fail fast), and once faults stop the half-open
+                 probe re-closes it — service recovers by itself
+  hedging        a hung replica dispatch is raced by a hedge on a
+                 second replica; the winner's result is the result
+  shedding       under pinned overload, admission control holds p95
+                 while the shed-disabled baseline's p95 collapses
+
+Phases run per seed (--seeds N => seeds 0..N-1); any violated
+property is reported and fails the gate. The heavier overload soak
+runs only with --slow. Wired into tier-1 by tests/test_chaos.py.
+
+    python tools/check_chaos.py [--seeds 3] [--slow]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# the replica phases need a multi-device mesh; standalone runs get
+# the same 8-device virtual CPU the test harness forces. A no-op (or
+# a failure) when a backend already exists — in-process callers
+# (tests/test_chaos.py) have already configured the platform.
+try:
+    from pluss_sampler_optimization_tpu._platform import (
+        force_virtual_cpu,
+    )
+
+    force_virtual_cpu(8)
+except Exception:
+    pass
+
+import loadgen  # noqa: E402
+from pluss_sampler_optimization_tpu.config import (  # noqa: E402
+    FaultConfig,
+    ResilienceConfig,
+)
+from pluss_sampler_optimization_tpu.runtime import faults  # noqa: E402
+
+TIMEOUT_S = 120.0
+
+
+def _requests(n: int, seed: int, unique_frac: float = 1.0) -> list:
+    """Deterministic request set with caller-supplied trace ids, so
+    replica_dispatch fault decisions (keyed on trace_id) replay."""
+    reqs = loadgen.make_requests(n, seed, unique_frac=unique_frac)
+    import dataclasses
+
+    return [
+        dataclasses.replace(r, trace_id=f"{r.id}-t") for r in reqs
+    ]
+
+
+def _service(cache_dir, resilience, seed, replicas=None,
+             service_time_s: float = 0.005):
+    from pluss_sampler_optimization_tpu.service import AnalysisService
+
+    return AnalysisService(
+        cache_dir=cache_dir, max_workers=4, replicas=replicas,
+        runner=loadgen.synthetic_runner(service_time_s, seed=seed),
+        resilience=resilience,
+    )
+
+
+def _run_all(svc, reqs) -> list:
+    tickets = [svc.submit(r) for r in reqs]
+    return [svc.result(t, timeout=TIMEOUT_S) for t in tickets]
+
+
+def _digests(resps) -> dict:
+    return {r.id: r.mrc_digest for r in resps}
+
+
+def _chaos_resilience(seed: int) -> ResilienceConfig:
+    # max_retries covers the summed max_fires of the failing
+    # engine_execute rules below (2 raise + 1 compile_failure), so a
+    # request can exhaust every injected failure and still succeed.
+    # Timing-coupled features stay OUT of this config — no
+    # attempt_timeout_s, no hedge_after_s — because this phase also
+    # checks exact REPLAY, and a wall-clock race (did the hedge fire
+    # before the attempt finished?) would change occurrence counts
+    # between runs; hangs/timeouts and hedging get their own phases.
+    # breaker_failures sits above any consecutive-failure run the mix
+    # can produce (the dedicated breaker phase tests breakers).
+    return ResilienceConfig(
+        max_retries=4,
+        backoff_base_s=0.01, backoff_max_s=0.05, backoff_seed=seed,
+        breaker_failures=50, breaker_probation_s=0.2,
+    )
+
+
+def _chaos_spec(seed: int) -> FaultConfig:
+    return FaultConfig(seed=seed, rules=(
+        {"site": "engine_execute", "kind": "raise", "p": 0.35,
+         "max_fires": 2},
+        {"site": "engine_execute", "kind": "compile_failure",
+         "p": 0.15, "max_fires": 1},
+        {"site": "replica_dispatch", "kind": "raise", "p": 0.2,
+         "max_fires": 1},
+        {"site": "replica_dispatch", "kind": "latency", "p": 0.25,
+         "latency_s": 0.03, "max_fires": 2},
+        {"site": "cache_store", "kind": "raise", "p": 0.4,
+         "max_fires": 1},
+    ))
+
+
+def _chaos_run(seed: int, reqs, cache_dir: str) -> dict:
+    """One armed run; returns the replay-comparable summary."""
+    injector = faults.install(_chaos_spec(seed))
+    try:
+        with _service(cache_dir, _chaos_resilience(seed), seed,
+                      replicas=2) as svc:
+            resps = _run_all(svc, reqs)
+            st = svc.executor.stats()
+        stats = injector.stats()
+    finally:
+        faults.uninstall()
+    return {
+        "ok_by_id": {r.id: r.ok for r in resps},
+        "digests": _digests(resps),
+        "fired_by_kind": stats["fired_by_kind"],
+        "resolved": len(resps),
+        "retried": st.get("retried", 0),
+        "shed": st.get("shed", 0),
+        "errors": {r.id: r.error for r in resps if not r.ok},
+    }
+
+
+def check_chaos_vs_baseline(seed: int, tmp: str,
+                            problems: list) -> None:
+    """Baseline digests -> chaos run (resolve-once, bit-identity) ->
+    replay (determinism) -> corrupt-on-load quarantine."""
+    reqs = _requests(8, seed, unique_frac=0.75)
+
+    with _service(os.path.join(tmp, "base"), _chaos_resilience(seed),
+                  seed, replicas=2) as svc:
+        base = _run_all(svc, reqs)
+    if not all(r.ok for r in base):
+        problems.append(f"seed {seed}: fault-free baseline failed: "
+                        f"{[r.error for r in base if not r.ok]}")
+        return
+    baseline = _digests(base)
+
+    runs = [
+        _chaos_run(seed, reqs, os.path.join(tmp, f"chaos{i}"))
+        for i in (0, 1)
+    ]
+    run = runs[0]
+    if run["resolved"] != len(reqs):
+        problems.append(
+            f"seed {seed}: {run['resolved']} of {len(reqs)} chaos "
+            "requests resolved (resolve-once violated)"
+        )
+    if sum(run["fired_by_kind"].values()) == 0:
+        problems.append(f"seed {seed}: chaos run injected nothing — "
+                        "the gate tested no faults")
+    bad = [i for i, ok in run["ok_by_id"].items() if not ok]
+    if bad:
+        problems.append(
+            f"seed {seed}: chaos requests failed despite a retry "
+            f"budget covering every injected fault: "
+            f"{ {i: run['errors'][i] for i in bad} }"
+        )
+    mismatch = {
+        i: (d, baseline.get(i))
+        for i, d in run["digests"].items()
+        if run["ok_by_id"][i] and d != baseline.get(i)
+    }
+    if mismatch:
+        problems.append(f"seed {seed}: chaos successes are NOT "
+                        f"bit-identical to baseline: {mismatch}")
+    failing = sum(
+        run["fired_by_kind"].get(k, 0)
+        for k in ("raise", "compile_failure", "hang")
+    )
+    if failing and run["retried"] == 0:
+        problems.append(f"seed {seed}: {failing} failing fault(s) "
+                        "fired but nothing was retried")
+    if runs[0] != runs[1]:
+        diff = {k: (runs[0][k], runs[1][k]) for k in runs[0]
+                if runs[0][k] != runs[1][k]}
+        problems.append(f"seed {seed}: chaos run did not replay "
+                        f"from (seed, spec): {diff}")
+
+    # corruption quarantine: re-read the chaos run's disk store with
+    # every first load mangled; records must be quarantined, counted,
+    # and recomputed to the baseline digests
+    store = os.path.join(tmp, "chaos0")
+    n_disk = len(glob.glob(os.path.join(store, "*", "*.json")))
+    faults.install(FaultConfig(seed=seed, rules=(
+        {"site": "cache_load", "kind": "corrupt", "p": 1.0,
+         "max_fires": 1},
+    )))
+    try:
+        with _service(store, _chaos_resilience(seed), seed) as svc:
+            resps = _run_all(svc, reqs)
+            cache_stats = svc.cache.stats()
+    finally:
+        faults.uninstall()
+    if not all(r.ok for r in resps):
+        problems.append(f"seed {seed}: requests failed after cache "
+                        "corruption (should recompute)")
+    if _digests(resps) != baseline:
+        problems.append(f"seed {seed}: post-corruption recomputes "
+                        "are not bit-identical to baseline")
+    quarantined = cache_stats.get("corrupt_quarantined", 0)
+    n_corrupt = len(glob.glob(os.path.join(store, "*", "*.corrupt")))
+    if n_disk and quarantined < 1:
+        problems.append(f"seed {seed}: {n_disk} disk records but "
+                        "none quarantined under corrupt faults")
+    if quarantined != n_corrupt:
+        problems.append(
+            f"seed {seed}: quarantine count {quarantined} != "
+            f"{n_corrupt} *.corrupt files on disk"
+        )
+
+
+def check_breaker_recovery(seed: int, problems: list) -> None:
+    """Failures open the engine breaker, open fails fast, and after
+    faults stop the half-open probe re-closes it; the first request
+    served after recovery is bit-identical to its fault-free run."""
+    from pluss_sampler_optimization_tpu.service import AnalysisRequest
+
+    reqs = [
+        AnalysisRequest(model=loadgen.MODEL, n=loadgen.MODEL_N,
+                        engine="sampled", ratio=0.2, seed=9000 + k,
+                        id=f"br-{k}", trace_id=f"br-{k}-t")
+        for k in range(5)
+    ]
+    with _service(None, None, seed) as svc:
+        want = svc.analyze(reqs[0], timeout=TIMEOUT_S).mrc_digest
+
+    res = ResilienceConfig(breaker_failures=2,
+                           breaker_probation_s=0.2)
+    faults.install(FaultConfig(seed=seed, rules=(
+        {"site": "engine_execute", "kind": "raise", "p": 1.0},
+    )))
+    try:
+        with _service(None, res, seed) as svc:
+            r1 = svc.analyze(reqs[1], timeout=TIMEOUT_S)
+            r2 = svc.analyze(reqs[2], timeout=TIMEOUT_S)
+            r3 = svc.analyze(reqs[3], timeout=TIMEOUT_S)
+            if r1.ok or r2.ok:
+                problems.append(f"seed {seed}: p=1.0 raise faults "
+                                "did not fail requests")
+            if r3.ok or "circuit breaker open" not in (r3.error or ""):
+                problems.append(
+                    f"seed {seed}: third request was not failed fast "
+                    f"by the open breaker (error: {r3.error!r})"
+                )
+            faults.uninstall()
+            time.sleep(0.25)  # let probation elapse
+            r4 = svc.analyze(reqs[4], timeout=TIMEOUT_S)
+            r5 = svc.analyze(reqs[0], timeout=TIMEOUT_S)
+            st = svc.executor.stats()
+    finally:
+        faults.uninstall()
+    if not (r4.ok and r5.ok):
+        problems.append(f"seed {seed}: service did not recover after "
+                        f"probation ({r4.error!r}, {r5.error!r})")
+    elif r5.mrc_digest != want:
+        problems.append(f"seed {seed}: post-recovery result is not "
+                        "bit-identical to the fault-free run")
+    br = (st.get("breakers") or {}).get("sampled") or {}
+    if st.get("breaker_opened", 0) < 1 \
+            or st.get("breaker_open_skips", 0) < 1 \
+            or st.get("breaker_reclosed", 0) < 1 \
+            or br.get("state") != "closed":
+        problems.append(
+            f"seed {seed}: breaker lifecycle counters wrong: "
+            f"opened={st.get('breaker_opened')} "
+            f"skips={st.get('breaker_open_skips')} "
+            f"reclosed={st.get('breaker_reclosed')} state={br}"
+        )
+
+
+def check_attempt_timeout(seed: int, problems: list) -> None:
+    """A hung attempt overruns the per-attempt budget, is abandoned,
+    and the seeded-backoff retry serves the request bit-identically."""
+    import dataclasses
+
+    from pluss_sampler_optimization_tpu.service import AnalysisRequest
+
+    req = AnalysisRequest(model=loadgen.MODEL, n=loadgen.MODEL_N,
+                          engine="sampled", ratio=0.2, seed=9500,
+                          threads=3, id="to-0", trace_id="to-0-t")
+    warm = dataclasses.replace(req, seed=9501, id="to-w",
+                               trace_id="to-w-t")
+    with _service(None, None, seed) as svc:
+        want = svc.analyze(req, timeout=TIMEOUT_S).mrc_digest
+    res = ResilienceConfig(attempt_timeout_s=0.25, max_retries=2,
+                           backoff_base_s=0.01, backoff_max_s=0.02,
+                           backoff_seed=seed)
+    with _service(None, res, seed) as svc:
+        # warm the runner memo with a DIFFERENT fingerprint before
+        # arming faults, so the hung request's retry attempt is far
+        # inside the 0.25s budget (no spurious second timeout)
+        svc.analyze(warm, timeout=TIMEOUT_S)
+        faults.install(FaultConfig(seed=seed, rules=(
+            {"site": "engine_execute", "kind": "hang", "p": 1.0,
+             "hang_s": 0.75, "max_fires": 1},
+        )))
+        try:
+            resp = svc.analyze(req, timeout=TIMEOUT_S)
+            st = svc.executor.stats()
+        finally:
+            faults.uninstall()
+    if not resp.ok or resp.retries < 1 or st.get("retried", 0) < 1:
+        problems.append(
+            f"seed {seed}: hung attempt was not abandoned+retried "
+            f"(ok={resp.ok} retries={resp.retries} "
+            f"error={resp.error!r})"
+        )
+    elif resp.mrc_digest != want:
+        problems.append(f"seed {seed}: post-timeout retry result is "
+                        "not bit-identical to the fault-free run")
+
+
+def check_hedging(seed: int, problems: list) -> None:
+    """Every primary dispatch hangs once; the hedge on the second
+    replica must win with bit-identical results."""
+    reqs = _requests(3, seed + 31)
+    with _service(None, None, seed) as svc:
+        want = _digests(_run_all(svc, reqs))
+    res = ResilienceConfig(hedge_after_s=0.1, breaker_failures=50)
+    faults.install(FaultConfig(seed=seed, rules=(
+        {"site": "replica_dispatch", "kind": "hang", "p": 1.0,
+         "hang_s": 0.6, "max_fires": 1},
+    )))
+    try:
+        with _service(None, res, seed, replicas=2) as svc:
+            resps = [svc.analyze(r, timeout=TIMEOUT_S) for r in reqs]
+            st = svc.executor.stats()
+    finally:
+        faults.uninstall()
+    if not all(r.ok for r in resps):
+        problems.append(f"seed {seed}: hedged requests failed: "
+                        f"{[r.error for r in resps if not r.ok]}")
+    elif _digests(resps) != want:
+        problems.append(f"seed {seed}: hedged results are not "
+                        "bit-identical to unhedged runs")
+    if st.get("hedged", 0) < 1:
+        problems.append(f"seed {seed}: hung dispatches never "
+                        "triggered a hedge")
+
+
+def check_serve_line_faults(seed: int, problems: list) -> None:
+    """serve_jsonl under per-line faults: every input line still gets
+    exactly one response entry; faulted lines carry the injected
+    error, the rest succeed."""
+    from pluss_sampler_optimization_tpu.service import serve_jsonl
+
+    lines = [
+        json.dumps({"model": loadgen.MODEL, "n": loadgen.MODEL_N,
+                    "engine": "sampled", "ratio": 0.2,
+                    "seed": 1000 + k, "id": f"sv-{k}"})
+        for k in range(4)
+    ]
+    injector = faults.install(FaultConfig(seed=seed, rules=(
+        {"site": "serve_line", "kind": "raise", "p": 0.5},
+    )))
+    try:
+        with _service(None, None, seed) as svc:
+            fout = io.StringIO()
+            failures = serve_jsonl(
+                svc, io.StringIO("\n".join(lines) + "\n"), fout
+            )
+        fired = injector.stats()["fired_by_kind"].get("raise", 0)
+    finally:
+        faults.uninstall()
+    entries = [json.loads(ln) for ln in
+               fout.getvalue().splitlines() if ln.strip()]
+    faulted = [e for e in entries
+               if "fault injected" in (e.get("error") or "")]
+    if len(entries) != len(lines):
+        problems.append(f"seed {seed}: {len(lines)} serve lines -> "
+                        f"{len(entries)} responses")
+    if len(faulted) != fired or failures != fired:
+        problems.append(
+            f"seed {seed}: serve_line fired {fired} but "
+            f"{len(faulted)} faulted entries / {failures} failures"
+        )
+    if any(not e.get("ok") for e in entries
+           if e not in faulted):
+        problems.append(f"seed {seed}: non-faulted serve lines "
+                        "failed")
+
+
+def check_overload(seed: int, problems: list, slow: bool) -> None:
+    """The pinned overload pair: same arrivals, shed on vs off."""
+    kw = dict(n=400, rate_rps=400.0, queue_limit=4, max_workers=2,
+              service_time_s=0.02, seed=seed) if slow else \
+         dict(n=60, rate_rps=300.0, queue_limit=4, max_workers=2,
+              service_time_s=0.02, seed=seed)
+    cmp = loadgen.overload_comparison(timeout_s=TIMEOUT_S, **kw)
+    on, off = cmp["shed_on"], cmp["shed_off"]
+    for label, rep in (("shed-on", on), ("shed-off", off)):
+        if rep["submitted"] != kw["n"] or rep["failed"]:
+            problems.append(
+                f"seed {seed}: overload {label} lost/failed requests"
+                f" ({rep['submitted']} resolved, {rep['failed']} "
+                "failed)"
+            )
+    if on["shed"] == 0:
+        problems.append(f"seed {seed}: overload never shed with the "
+                        "admission gate on")
+    if off["shed"] != 0:
+        problems.append(f"seed {seed}: shed-disabled run shed "
+                        f"{off['shed']} requests")
+    p95_on = on["latency_p95_s"] or 0.0
+    p95_off = off["latency_p95_s"] or 0.0
+    if p95_off <= p95_on:
+        problems.append(
+            f"seed {seed}: shedding showed no tail benefit "
+            f"(p95 on={p95_on} off={p95_off})"
+        )
+    if slow:
+        # the soak pins the SLO numbers, not just the ordering
+        if p95_on > 0.6:
+            problems.append(f"seed {seed}: soak p95 {p95_on}s with "
+                            "shedding on blows the 0.6s SLO")
+        if p95_off < 1.2:
+            problems.append(
+                f"seed {seed}: soak baseline p95 {p95_off}s did not "
+                "collapse (load too light to prove shedding)"
+            )
+
+
+def run_seed(seed: int, slow: bool) -> list[str]:
+    problems: list[str] = []
+    tmp = tempfile.mkdtemp(prefix=f"check_chaos_s{seed}_")
+    try:
+        t0 = time.perf_counter()
+        check_chaos_vs_baseline(seed, tmp, problems)
+        check_breaker_recovery(seed, problems)
+        check_attempt_timeout(seed, problems)
+        check_hedging(seed, problems)
+        check_serve_line_faults(seed, problems)
+        check_overload(seed, problems, slow)
+        print(f"check_chaos: seed {seed}: "
+              f"{'OK' if not problems else 'FAIL'} "
+              f"({time.perf_counter() - t0:.1f}s)")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded chaos gate: fault injection, retries, "
+        "hedging, breakers, quarantine, and load shedding"
+    )
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="run seeds 0..N-1 (default 3)")
+    ap.add_argument("--slow", action="store_true",
+                    help="include the overload soak with pinned SLO "
+                    "numbers")
+    args = ap.parse_args(argv)
+    if faults.get() is not None:
+        # a leftover injector would corrupt every phase's baseline
+        faults.uninstall()
+    problems: list[str] = []
+    for seed in range(args.seeds):
+        problems += run_seed(seed, args.slow)
+    for p in problems:
+        print(f"check_chaos: FAIL: {p}", file=sys.stderr)
+    print(f"check_chaos: {args.seeds} seed(s), "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

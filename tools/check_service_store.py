@@ -11,12 +11,17 @@ the tools/check_telemetry_schema.py pattern), and reports
 - corrupt entries: unparseable JSON, wrong store_version, missing
   required keys, or a fingerprint that does not match the address;
 - stale entries: older than --max-age-days (0 disables the age check);
+- quarantined entries: `*.corrupt` files the in-process loader
+  renamed aside after a failed validation (service/cache.py) — kept
+  for post-mortem, reported here, deleted by --gc;
 - stray files: non-record files inside the store tree.
 
-With --gc, corrupt and stale entries (and orphaned .tmp files from
-interrupted writers) are deleted; the exit code is then 0 because the
-store has been repaired. Without --gc the exit code is nonzero when
-anything invalid was found, so CI can gate on store health.
+With --gc, corrupt, stale, and quarantined entries (and orphaned
+.tmp files from interrupted writers) are deleted; the exit code is
+then 0 because the store has been repaired. Without --gc the exit
+code is nonzero when anything invalid was found, so CI can gate on
+store health (quarantined files are informational: the loader
+already repaired the live address).
 
     python tools/check_service_store.py CACHE_DIR [--gc]
         [--max-age-days N]
@@ -45,7 +50,7 @@ def scan_store(cache_dir: str, max_age_days: float = 0.0) -> dict:
     )
 
     out: dict = {"valid": [], "corrupt": [], "stale": [], "tmp": [],
-                 "stray": []}
+                 "quarantined": [], "stray": []}
     now = time.time()
     max_age_s = max_age_days * 86400.0
     for root, _dirs, files in os.walk(cache_dir):
@@ -53,6 +58,9 @@ def scan_store(cache_dir: str, max_age_days: float = 0.0) -> dict:
             path = os.path.join(root, name)
             if name.endswith(".tmp"):
                 out["tmp"].append(path)
+                continue
+            if name.endswith(".corrupt"):
+                out["quarantined"].append(path)
                 continue
             if not name.endswith(".json"):
                 out["stray"].append(path)
@@ -101,6 +109,8 @@ def main(argv=None) -> int:
               f"{args.max_age_days:g} days)", file=sys.stderr)
     for path in scan["tmp"]:
         print(f"{path}: orphaned tmp file", file=sys.stderr)
+    for path in scan["quarantined"]:
+        print(f"{path}: quarantined corrupt record", file=sys.stderr)
     for path in scan["stray"]:
         print(f"{path}: stray file (not a store record)",
               file=sys.stderr)
@@ -109,7 +119,7 @@ def main(argv=None) -> int:
     if args.gc:
         doomed = (
             [p for p, _ in scan["corrupt"]]
-            + scan["stale"] + scan["tmp"]
+            + scan["stale"] + scan["tmp"] + scan["quarantined"]
         )
         for path in doomed:
             try:
@@ -122,7 +132,9 @@ def main(argv=None) -> int:
     print(
         f"{args.cache_dir}: {len(scan['valid'])} valid, "
         f"{len(scan['corrupt'])} corrupt, {len(scan['stale'])} stale, "
-        f"{len(scan['tmp'])} tmp, {len(scan['stray'])} stray"
+        f"{len(scan['tmp'])} tmp, "
+        f"{len(scan['quarantined'])} quarantined, "
+        f"{len(scan['stray'])} stray"
         + (f"; removed {removed}" if args.gc else "")
     )
     if args.gc:

@@ -4,15 +4,19 @@ The repo's serving story rests on a handful of functions whose output
 must be a pure value function of their inputs: the request
 fingerprint (service/fingerprint.py — cache addresses), the CRI
 distribution and histogram folds (runtime/cri.py, runtime/hist.py —
-the MRC bytes themselves), and the ledger's MRC digest
-(runtime/obs/ledger.py::mrc_digest — the cross-run attribution key).
+the MRC bytes themselves), the ledger's MRC digest
+(runtime/obs/ledger.py::mrc_digest — the cross-run attribution key),
+and the chaos layer's counter hash and seeded backoff jitter
+(runtime/faults.py::_mix/counter_u01/backoff_delay — fault replay
+and retry schedules must be pure functions of (seed, path)).
 A wall-clock read, an RNG draw, a PYTHONHASHSEED-dependent `hash()`,
 or iteration over an unordered set silently breaks the bit-identity
 contract tier-1 pins everywhere else.
 
 This lint walks the AST of those targets and reports:
 
-  wallclock   time.time / time.time_ns / datetime.now / utcnow
+  wallclock   time.time / time.time_ns / perf_counter / monotonic /
+              datetime.now / utcnow
   entropy     random.* / np.random.* / numpy.random.* / os.urandom /
               uuid.uuid4 / secrets.*
   hashseed    the builtin hash() (PYTHONHASHSEED-dependent)
@@ -49,6 +53,11 @@ TARGETS = (
     (f"{PKG}/runtime/cri.py", None),
     (f"{PKG}/runtime/hist.py", None),
     (f"{PKG}/runtime/obs/ledger.py", "mrc_digest"),
+    # chaos layer: fault decisions and backoff jitter replay from
+    # (seed, path) — any clock or RNG here breaks chaos-run replay
+    (f"{PKG}/runtime/faults.py", "_mix"),
+    (f"{PKG}/runtime/faults.py", "counter_u01"),
+    (f"{PKG}/runtime/faults.py", "backoff_delay"),
 )
 
 ALLOWLIST_PATH = os.path.join(
@@ -57,7 +66,8 @@ ALLOWLIST_PATH = os.path.join(
 )
 
 # dotted-name bans: exact names, or prefixes ending in "."
-_WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
+_WALLCLOCK = {"time.time", "time.time_ns", "time.perf_counter",
+              "time.monotonic", "datetime.now",
               "datetime.utcnow", "datetime.datetime.now",
               "datetime.datetime.utcnow"}
 _ENTROPY_EXACT = {"os.urandom", "uuid.uuid4"}
