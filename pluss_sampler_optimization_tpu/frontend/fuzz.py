@@ -290,9 +290,17 @@ def _fold_mrc(state, machine: MachineConfig) -> np.ndarray:
     return np.asarray(aet_mrc(rih, machine), dtype=np.float64)
 
 
+def _states_equal(a, b, thread_num: int) -> bool:
+    for t in range(thread_num):
+        if a.noshare[t] != b.noshare[t] or a.share[t] != b.share[t]:
+            return False
+    return True
+
+
 def check_seed(seed: int, ratio: float = RATIO,
                drift_max: float = DRIFT_MAX,
-               n_mutants: int = 4, sampled: bool = True) -> dict:
+               n_mutants: int = 4, sampled: bool = True,
+               batched: bool = False, sharded: bool = False) -> dict:
     """Run the full contract for one seed; returns a result dict with
     `ok` plus per-check fields (never raises on a contract failure —
     failures land in `errors` so a sweep reports them all).
@@ -300,7 +308,15 @@ def check_seed(seed: int, ratio: float = RATIO,
     `sampled=False` skips the sampled-engine drift check (each fresh
     program shape costs a jax trace+compile — the tier-1 smoke runs
     the cheap checks over many seeds and leaves the sampled sweep to
-    the slow marker and the tools/fuzz_ir.py gate)."""
+    the slow marker and the tools/fuzz_ir.py gate).
+
+    `batched=True` additionally runs the seed's program through
+    run_sampled_multi in a 3-job union bucket (primary, a companion
+    from seed+1, primary again) and requires job 0 bit-identical to
+    the solo run and job 2 bit-identical to job 0. `sharded=True`
+    runs run_sampled_sharded on a 2-device mesh (the caller must have
+    pinned a multi-device platform, e.g. force_virtual_cpu) and
+    requires bit-identity to solo. Both imply a solo sampled run."""
     from ..oracle.numpy_ref import run_numpy
     from ..sampler.periodic import run_exact
 
@@ -327,19 +343,57 @@ def check_seed(seed: int, ratio: float = RATIO,
         errors.append("exact: PRIState/MRC not bit-identical to oracle")
 
     drift = 0.0
-    if sampled:
+    if sampled or batched or sharded:
         from ..config import SamplerConfig
         from ..sampler.sampled import run_sampled
 
-        state, _ = run_sampled(program, machine,
-                               SamplerConfig(ratio=ratio, seed=seed))
+        cfg = SamplerConfig(ratio=ratio, seed=seed)
+        state, _ = run_sampled(program, machine, cfg)
         mrc_sampled = _fold_mrc(state, machine)
         k = min(len(mrc_sampled), len(mrc_oracle))
         drift = float(np.max(
             np.abs(mrc_sampled[:k] - mrc_oracle[:k]))) if k else 0.0
-        if drift > drift_max:
+        if sampled and drift > drift_max:
             errors.append(
                 f"sampled: MRC drift {drift:.3f} exceeds {drift_max}")
+
+    if batched:
+        from ..sampler.sampled import run_sampled_multi
+
+        # a 3-job union bucket: the companion forces genuinely mixed
+        # batch membership, and the repeated primary must come back
+        # bit-identical to the first copy at zero extra compile cost
+        companion = (generate_program(seed + 1),
+                     generate_machine(seed + 1),
+                     SamplerConfig(ratio=ratio, seed=seed + 1), False)
+        outs = run_sampled_multi([
+            (program, machine, cfg, False), companion,
+            (program, machine, cfg, False),
+        ])
+        b0, b2 = outs[0][0], outs[2][0]
+        if (not _states_equal(b0, state, machine.thread_num)
+                or _fold_mrc(b0, machine).tobytes()
+                != mrc_sampled.tobytes()):
+            errors.append(
+                "batched: job 0 PRIState/MRC not bit-identical to solo")
+        if (not _states_equal(b2, b0, machine.thread_num)
+                or _fold_mrc(b2, machine).tobytes()
+                != _fold_mrc(b0, machine).tobytes()):
+            errors.append(
+                "batched: repeated member diverges inside one bucket")
+
+    if sharded:
+        from ..parallel.mesh import build_mesh
+        from ..parallel.sharded import run_sampled_sharded
+
+        state_sh, _ = run_sampled_sharded(
+            program, machine, cfg, mesh=build_mesh(2))
+        if (not _states_equal(state_sh, state, machine.thread_num)
+                or _fold_mrc(state_sh, machine).tobytes()
+                != mrc_sampled.tobytes()):
+            errors.append(
+                "sharded: PRIState/MRC not bit-identical to solo "
+                "on the 2-device mesh")
 
     rejected = 0
     mutants = mutate_invalid(doc, seed, count=n_mutants)
@@ -373,14 +427,16 @@ def check_seed(seed: int, ratio: float = RATIO,
 
 def run_seeds(n: int, start: int = 0, ratio: float = RATIO,
               drift_max: float = DRIFT_MAX, n_mutants: int = 4,
-              sampled: bool = True, progress=None) -> dict:
+              sampled: bool = True, batched: bool = False,
+              sharded: bool = False, progress=None) -> dict:
     """Sweep seeds [start, start+n); summary dict with every failing
     seed's result embedded (empty `failures` == clean sweep)."""
     failures = []
     worst: Optional[dict] = None
     for seed in range(start, start + n):
         r = check_seed(seed, ratio=ratio, drift_max=drift_max,
-                       n_mutants=n_mutants, sampled=sampled)
+                       n_mutants=n_mutants, sampled=sampled,
+                       batched=batched, sharded=sharded)
         if worst is None or r["sampled_drift"] > worst["sampled_drift"]:
             worst = r
         if not r["ok"]:

@@ -50,7 +50,7 @@ import threading
 import time
 
 from ..config import FaultConfig
-from . import telemetry
+from . import lockwitness, telemetry
 
 
 class FaultInjected(RuntimeError):
@@ -106,7 +106,7 @@ class FaultInjector:
 
     def __init__(self, config: FaultConfig):
         self.config = config
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("FaultInjector._lock")
         # occurrences per (site, key): the counter component of the
         # (seed, site, rule, key, occurrence) draw
         self._occurrences: collections.Counter = collections.Counter()
@@ -168,7 +168,7 @@ class FaultInjector:
         return None
 
 
-_INSTALL_LOCK = threading.Lock()
+_INSTALL_LOCK = lockwitness.make_lock("faults._INSTALL_LOCK")
 _INJECTOR: FaultInjector | None = None
 
 

@@ -43,6 +43,8 @@ import json
 import threading
 import time
 
+from .. import lockwitness
+
 
 # Default latency buckets (seconds). Chosen to resolve both the
 # sub-millisecond cache-hit path and multi-second exact-engine runs;
@@ -278,7 +280,7 @@ class MetricsRegistry:
 
     def __init__(self, buckets=DEFAULT_BUCKETS,
                  windows=DEFAULT_WINDOWS):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("MetricsRegistry._lock")
         self._buckets = tuple(buckets)
         self._windows = tuple(windows)
         self._counters: dict = {}          # name -> float total
@@ -408,7 +410,7 @@ class MetricsRegistry:
 # -- process-global switch --------------------------------------------
 
 _registry: "MetricsRegistry | None" = None
-_registry_lock = threading.Lock()
+_registry_lock = lockwitness.make_lock("metrics._registry_lock")
 
 
 def enable(buckets=DEFAULT_BUCKETS,

@@ -49,7 +49,7 @@ import threading
 import time
 from collections import deque
 
-from .. import telemetry
+from .. import lockwitness, telemetry
 from ..io import atomic_write_json
 
 BUNDLE_VERSION = 1
@@ -233,7 +233,7 @@ class FlightRecorder:
         # snapshot, executor stats); attached by the CLI once the
         # service exists, so construction order stays flexible.
         self.state_provider = state_provider
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("FlightRecorder._lock")
         self._ring: deque = deque()
         self._retained: deque = deque(maxlen=self.retain_capacity)
         self._latencies: deque = deque(maxlen=max(8, outlier_window))
@@ -298,9 +298,10 @@ class FlightRecorder:
             rec.setdefault("ok", not rec.get("error"))
             failed = rec["ok"] is False or bool(rec.get("error"))
             with self._lock:
-                self._ingest(rec)
+                evicted = self._ingest_locked(rec)
                 if _is_num(rec.get("latency_s")):
                     self._latencies.append(float(rec["latency_s"]))
+            self._emit_retained_evicted(evicted)
             telemetry.count("recorder_records")
             if failed:
                 self.trigger("request_failure", trigger={
@@ -316,14 +317,19 @@ class FlightRecorder:
         ring records, and trigger events fire a bundle."""
         rec = {"kind": "event", "name": name, "data": dict(data)}
         with self._lock:
-            self._ingest(rec)
+            evicted = self._ingest_locked(rec)
+        self._emit_retained_evicted(evicted)
         reason = TRIGGER_EVENTS.get(name)
         if reason is not None:
             self.trigger(reason, trigger={"event": name, **data})
 
-    def _ingest(self, rec: dict) -> None:
+    def _ingest_locked(self, rec: dict) -> int:
         """Stamp + append under the lock, promoting the interesting
-        on eviction (tail-based retention)."""
+        on eviction (tail-based retention). Returns how many retained
+        records fell off so the caller can emit telemetry after
+        releasing `_lock` — the sink legs (metrics registry, and
+        record_event right back into this recorder) take their own
+        locks."""
         self._seq += 1
         self._seen += 1
         rec["seq"] = self._seq
@@ -332,14 +338,21 @@ class FlightRecorder:
             rec["span_tree"] = _span_tree(rec)
         rec["retained"] = self._classify(rec)
         self._ring.append(rec)
+        retained_evicted = 0
         while len(self._ring) > self.capacity:
             old = self._ring.popleft()
             if old.get("retained") is not None:
                 if len(self._retained) == self._retained.maxlen:
-                    telemetry.count("recorder_retained_evicted")
+                    retained_evicted += 1
                 self._retained.append(old)
             else:
                 self._evicted += 1
+        return retained_evicted
+
+    @staticmethod
+    def _emit_retained_evicted(evicted: int) -> None:
+        for _ in range(evicted):
+            telemetry.count("recorder_retained_evicted")
 
     # -- triggers / bundles --------------------------------------------
 
@@ -355,16 +368,24 @@ class FlightRecorder:
         """
         now = time.monotonic()
         with self._lock:
-            if not force:
-                last = self._last_bundle.get(reason)
-                if last is not None and (
-                    now - last
-                ) < self.min_interval_s:
-                    self._suppressed += 1
-                    telemetry.count("recorder_bundle_suppressed")
-                    return None
-            self._last_bundle[reason] = now
-            self._triggers[reason] = self._triggers.get(reason, 0) + 1
+            last = self._last_bundle.get(reason)
+            suppressed = (
+                not force
+                and last is not None
+                and (now - last) < self.min_interval_s
+            )
+            if suppressed:
+                self._suppressed += 1
+            else:
+                self._last_bundle[reason] = now
+                self._triggers[reason] = (
+                    self._triggers.get(reason, 0) + 1
+                )
+        if suppressed:
+            # sink emission after release (C_SINK_UNDER_LOCK): the
+            # suppressed counter must not extend the hold time
+            telemetry.count("recorder_bundle_suppressed")
+            return None
         try:
             path = self._write_bundle(reason, trigger or {})
         except Exception:
@@ -503,7 +524,7 @@ class FlightRecorder:
 # -- process-global switch --------------------------------------------
 
 _recorder: "FlightRecorder | None" = None
-_recorder_lock = threading.Lock()
+_recorder_lock = lockwitness.make_lock("recorder._recorder_lock")
 
 
 def enable(bundle_dir: str, **kwargs) -> FlightRecorder:

@@ -65,6 +65,8 @@ import sys
 import threading
 import time
 
+from . import lockwitness
+
 SCHEMA_VERSION = 1
 
 # Recorded-span cap: a pathological run (millions of chunks) degrades
@@ -72,7 +74,7 @@ SCHEMA_VERSION = 1
 _MAX_SPANS = 50_000
 _MAX_EVENTS = 1_000
 
-_lock = threading.Lock()
+_lock = lockwitness.make_lock("telemetry._lock")
 _tls = threading.local()
 _current: "Telemetry | None" = None
 

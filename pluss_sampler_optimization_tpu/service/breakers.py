@@ -28,6 +28,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..runtime import lockwitness
+
 
 class CircuitBreaker:
     """Thread-safe closed/open/half-open breaker."""
@@ -41,7 +43,7 @@ class CircuitBreaker:
         self.escalation = float(escalation)
         self.probation_max_s = float(probation_max_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("CircuitBreaker._lock")
         self._state = "closed"
         self._consecutive = 0
         self._probation_s = self.base_probation_s

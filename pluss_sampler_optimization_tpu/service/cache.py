@@ -39,7 +39,7 @@ import json
 import os
 import threading
 
-from ..runtime import faults, telemetry
+from ..runtime import faults, lockwitness, telemetry
 from ..runtime.io import atomic_write_json
 
 # Version of the RESULT RECORD shape (the dict produced by
@@ -124,7 +124,7 @@ class ResultCache:
         self.cache_dir = os.fspath(cache_dir) if cache_dir else None
         self.mem_entries = mem_entries
         self._mem: collections.OrderedDict = collections.OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("ResultCache._lock")
         # instance-local mirror of the telemetry counters: the serve
         # introspection protocol (`stats` request) must report cache
         # health even when no telemetry run is active
@@ -171,13 +171,18 @@ class ResultCache:
             if rec is not None:
                 self._mem.move_to_end(fingerprint)
                 self._stats["hit_mem"] += 1
-                telemetry.count("service_cache_hit_mem")
-                return rec, "mem"
+        if rec is not None:
+            # sink emission stays outside the critical section: the
+            # metrics registry has its own lock and the flight
+            # recorder does real work (C_SINK_UNDER_LOCK)
+            telemetry.count("service_cache_hit_mem")
+            return rec, "mem"
         if self.cache_dir:
             rec = self._load_disk(fingerprint)
             if rec is not None:
                 with self._lock:
-                    self._mem_put(fingerprint, rec)
+                    evicted = self._mem_put_locked(fingerprint, rec)
+                self._emit_evictions(evicted)
                 self._count("hit_disk")
                 telemetry.count("service_cache_hit_disk")
                 return rec, "disk"
@@ -220,7 +225,8 @@ class ResultCache:
 
     def put(self, fingerprint: str, record: dict) -> None:
         with self._lock:
-            self._mem_put(fingerprint, record)
+            evicted = self._mem_put_locked(fingerprint, record)
+        self._emit_evictions(evicted)
         if self.cache_dir:
             path = self.path_for(fingerprint)
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -234,10 +240,20 @@ class ResultCache:
                 self._count("write_failed")
                 telemetry.count("service_cache_write_failed")
 
-    def _mem_put(self, fingerprint: str, record: dict) -> None:
+    def _mem_put_locked(self, fingerprint: str, record: dict) -> int:
+        """Install + LRU-evict; caller holds `_lock`. Returns the
+        eviction count so the caller can emit telemetry after
+        release."""
         self._mem[fingerprint] = record
         self._mem.move_to_end(fingerprint)
+        evicted = 0
         while len(self._mem) > self.mem_entries:
             self._mem.popitem(last=False)
             self._stats["evictions"] += 1
+            evicted += 1
+        return evicted
+
+    @staticmethod
+    def _emit_evictions(evicted: int) -> None:
+        for _ in range(evicted):
             telemetry.count("service_cache_evictions")

@@ -94,7 +94,7 @@ from ..config import (
     SamplerConfig,
 )
 from ..ir import Program
-from ..runtime import faults, report, telemetry
+from ..runtime import faults, lockwitness, report, telemetry
 from ..runtime.aet import aet_mrc
 from ..runtime.cri import cri_distribute
 from ..runtime.obs import ledger as obs_ledger
@@ -341,7 +341,7 @@ class BatchScheduler:
         self._window_s = max(0.0, window_ms) / 1000.0
         self._max_refs = max(1, max_refs)
         self._queue: list[_BatchEntry] = []
-        self._cv = threading.Condition()
+        self._cv = lockwitness.make_condition("BatchScheduler._cv")
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -358,8 +358,11 @@ class BatchScheduler:
             if self._closed:
                 raise RuntimeError("batch scheduler is closed")
             self._queue.append(entry)
-            telemetry.gauge("batch_queue_depth", len(self._queue))
+            depth = len(self._queue)
             self._cv.notify()
+        # gauge outside the condition lock (C_SINK_UNDER_LOCK): the
+        # sink takes the metrics-registry lock
+        telemetry.gauge("batch_queue_depth", depth)
 
     def close(self) -> None:
         """Stop admitting; the loop flushes whatever is queued before
@@ -385,7 +388,6 @@ class BatchScheduler:
                 break
             batch.append(self._queue.pop(0))
             total += e.refs
-        telemetry.gauge("batch_queue_depth", len(self._queue))
         return batch
 
     def _loop(self) -> None:
@@ -412,9 +414,6 @@ class BatchScheduler:
                         # them until the window flushes; the survivors
                         # keep waiting on the next outer iteration
                         self._queue = live
-                        telemetry.gauge(
-                            "batch_queue_depth", len(self._queue)
-                        )
                         break
                     if now >= flush_at or (
                         sum(e.refs for e in self._queue)
@@ -431,9 +430,12 @@ class BatchScheduler:
                     # closed: drain whatever is still queued (one
                     # max_refs-bounded batch per outer iteration)
                     batch = self._pop_batch_locked()
-            # executor work runs OUTSIDE the condition lock: expiry
+                depth = len(self._queue)
+            # executor work — and telemetry, whose sinks take their
+            # own locks — runs OUTSIDE the condition lock: expiry
             # resolves futures (whose callbacks take executor locks)
             # and _submit_batch touches the pool
+            telemetry.gauge("batch_queue_depth", depth)
             for e in expired:
                 self._executor._expire_queued(e)
             if batch:
@@ -490,7 +492,7 @@ class RequestExecutor:
             thread_name_prefix="pluss-service",
         )
         self._inflight: dict[str, Future] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("RequestExecutor._lock")
         # instance-local counters backing the serve `stats`/`healthz`
         # introspection protocol — telemetry counters only exist while
         # a run is enabled, but a long-lived service must answer
@@ -657,6 +659,7 @@ class RequestExecutor:
             self._batcher is not None and self._batchable(request)
         )
         entry = None
+        shed_reason = None
         with self._lock:
             self._stats["submitted"] += 1
             fut = self._inflight.get(fingerprint)
@@ -666,26 +669,32 @@ class RequestExecutor:
                 # remembered per fingerprint so the row can report how
                 # many submissions it answered
                 self._coalesced_by_fp[fingerprint] += 1
-                telemetry.count("service_coalesced")
-                return fut
-            # admission gate — AFTER the coalesce join (joining an
-            # in-flight execution costs nothing, so it is never shed)
-            # and BEFORE any queue/pool state is touched, so a shed
-            # is a cheap structured refusal, not an expensive timeout
-            shed_reason = None
-            priority = getattr(request, "priority", "normal")
-            if self._draining:
-                shed_reason = "service draining (shutdown in progress)"
-            elif (self._resilience.queue_limit is not None
-                    and self._resilience.shed_enabled):
-                depth = (len(self._inflight)
-                         - self._stats.get("active", 0))
-                limit = self._admission_limit(priority)
-                if depth >= limit:
+            else:
+                # admission gate — AFTER the coalesce join (joining an
+                # in-flight execution costs nothing, so it is never
+                # shed) and BEFORE any queue/pool state is touched, so
+                # a shed is a cheap structured refusal, not an
+                # expensive timeout
+                priority = getattr(request, "priority", "normal")
+                if self._draining:
                     shed_reason = (
-                        f"queue depth {depth} at admission limit "
-                        f"{limit} for priority {priority!r}"
+                        "service draining (shutdown in progress)"
                     )
+                elif (self._resilience.queue_limit is not None
+                        and self._resilience.shed_enabled):
+                    depth = (len(self._inflight)
+                             - self._stats.get("active", 0))
+                    limit = self._admission_limit(priority)
+                    if depth >= limit:
+                        shed_reason = (
+                            f"queue depth {depth} at admission limit "
+                            f"{limit} for priority {priority!r}"
+                        )
+        if fut is not None:
+            # count outside the lock (C_SINK_UNDER_LOCK): the sink
+            # takes the metrics-registry lock
+            telemetry.count("service_coalesced")
+            return fut
         if shed_reason is not None:
             return self._shed(request, fingerprint, shed_reason,
                               preflight, submitted_at)
@@ -693,13 +702,11 @@ class RequestExecutor:
             # re-check the singleflight join: the gate ran outside
             # the first critical section, so an identical fingerprint
             # may have landed in between
-            fut = self._inflight.get(fingerprint)
-            if fut is not None:
+            coalesced = self._inflight.get(fingerprint)
+            if coalesced is not None:
                 self._stats["coalesced"] += 1
                 self._coalesced_by_fp[fingerprint] += 1
-                telemetry.count("service_coalesced")
-                return fut
-            if batchable:
+            elif batchable:
                 # the admission window resolves this future itself;
                 # singleflight still coalesces identical fingerprints
                 # onto it while it waits or runs
@@ -716,20 +723,25 @@ class RequestExecutor:
                     ),
                     preflight=preflight,
                 )
+                self._inflight[fingerprint] = fut
             else:
                 fut = self._pool.submit(
                     self._process, request, program, machine,
                     fingerprint, submitted_at, preflight,
                 )
-            self._inflight[fingerprint] = fut
-            telemetry.gauge("service_queue_depth", len(self._inflight))
+                self._inflight[fingerprint] = fut
+            depth = len(self._inflight)
+        # sinks outside the lock (C_SINK_UNDER_LOCK)
+        if coalesced is not None:
+            telemetry.count("service_coalesced")
+            return coalesced
+        telemetry.gauge("service_queue_depth", depth)
 
         def _done(_f, fp=fingerprint):
             with self._lock:
                 self._inflight.pop(fp, None)
-                telemetry.gauge(
-                    "service_queue_depth", len(self._inflight)
-                )
+                depth = len(self._inflight)
+            telemetry.gauge("service_queue_depth", depth)
 
         # registered OUTSIDE the lock: a future that already finished
         # runs the callback synchronously on this thread, and the

@@ -61,7 +61,7 @@ import time
 from concurrent.futures import Future
 
 from ..config import ReplicaConfig, ResilienceConfig, SamplerConfig
-from ..runtime import faults, telemetry
+from ..runtime import faults, lockwitness, telemetry
 
 
 def current_replica_id():
@@ -156,7 +156,7 @@ class ReplicaPool:
             self.replicas.append(
                 Replica(rid, group, build_mesh(devices=group))
             )
-        self._cv = threading.Condition()
+        self._cv = lockwitness.make_condition("ReplicaPool._cv")
         self._closed = False
         self._rr = 0  # round-robin cursor for routing ties
         self._workers = [
@@ -184,17 +184,22 @@ class ReplicaPool:
         fut.set_running_or_notify_cancel()
         work = _Work(fn, fut, trace_id, members,
                      pinned or replica_id is not None)
+        promoted: list[int] = []
         with self._cv:
             if self._closed:
                 raise RuntimeError("replica pool is closed")
             if replica_id is not None:
                 target = self.replicas[replica_id]
             else:
-                target = self._route_locked()
+                target = self._route_locked(promoted)
             target.queue.append(work)
             target.routed += work.members
-            self._gauges_locked()
+            gauges = self._gauges_snapshot_locked()
             self._cv.notify_all()
+        # telemetry outside the condition lock (C_SINK_UNDER_LOCK):
+        # sinks take their own locks and the recorder leg does work
+        self._emit_promotions(promoted)
+        self._emit_gauges(gauges)
         telemetry.count("requests_routed", work.members)
         return fut
 
@@ -298,20 +303,23 @@ class ReplicaPool:
 
     # -- routing ------------------------------------------------------
 
-    def _route_locked(self) -> Replica:
+    def _route_locked(self, promoted: list | None = None) -> Replica:
         """Least-loaded live replica (queue + executing), round-robin
         among ties. An OPEN replica whose probation has elapsed is
         promoted to half_open and takes this one work item as its
         probe (success re-closes it in _execute; failure re-opens
         escalated in _handle_failure). All-open pools route across
-        the full set: best-effort beats going dark."""
+        the full set: best-effort beats going dark.
+
+        Promotions are appended to `promoted` (replica ids) for the
+        caller to emit via _emit_promotions AFTER releasing `_cv` —
+        never from inside the critical section."""
         now = time.monotonic()
         for r in self.replicas:
             if r.state == "open" and now >= r.reopen_at:
                 r.state = "half_open"
-                telemetry.count("replica_breaker_half_open")
-                telemetry.event("replica_breaker_half_open",
-                                replica=r.rid)
+                if promoted is not None:
+                    promoted.append(r.rid)
                 return r
         live = [r for r in self.replicas if r.state == "closed"]
         if not live:
@@ -328,31 +336,55 @@ class ReplicaPool:
         and cancels whichever copy has not started when the first
         result lands). True when the item was found and removed; False
         means it is executing (or done) and will resolve normally."""
+        gauges = None
         with self._cv:
             for r in self.replicas:
                 for w in r.queue:
                     if w.future is future:
                         r.queue.remove(w)
-                        self._gauges_locked()
-                        telemetry.count("replica_work_cancelled")
-                        return True
-        return False
+                        gauges = self._gauges_snapshot_locked()
+                        break
+                if gauges is not None:
+                    break
+        if gauges is None:
+            return False
+        self._emit_gauges(gauges)
+        telemetry.count("replica_work_cancelled")
+        return True
 
-    def _gauges_locked(self) -> None:
+    def _gauges_snapshot_locked(self) -> list:
+        """(name, value) pairs computed under `_cv`; the caller emits
+        them with _emit_gauges after release (C_SINK_UNDER_LOCK)."""
         busy = sum(1 for r in self.replicas if r.busy)
         queued = sum(len(r.queue) for r in self.replicas)
-        telemetry.gauge("replica_utilization",
-                        round(busy / len(self.replicas), 4))
-        telemetry.gauge("replica_queue_depth", queued)
+        pairs = [
+            ("replica_utilization",
+             round(busy / len(self.replicas), 4)),
+            ("replica_queue_depth", queued),
+        ]
         for r in self.replicas:
-            telemetry.gauge(f"replica_queue_depth_r{r.rid}",
-                            len(r.queue))
+            pairs.append(
+                (f"replica_queue_depth_r{r.rid}", len(r.queue))
+            )
+        return pairs
+
+    @staticmethod
+    def _emit_gauges(pairs: list) -> None:
+        for name, value in pairs:
+            telemetry.gauge(name, value)
+
+    @staticmethod
+    def _emit_promotions(promoted: list) -> None:
+        for rid in promoted:
+            telemetry.count("replica_breaker_half_open")
+            telemetry.event("replica_breaker_half_open", replica=rid)
 
     # -- worker -------------------------------------------------------
 
     def _worker(self, replica: Replica) -> None:
         while True:
             work = None
+            stolen_members = 0
             with self._cv:
                 while work is None:
                     if self._closed:
@@ -361,18 +393,25 @@ class ReplicaPool:
                         work = replica.queue.popleft()
                     elif not replica.quarantined:
                         work = self._steal_locked(replica)
+                        if work is not None:
+                            stolen_members = work.members
                     if work is None:
                         self._cv.wait()
                 replica.busy = True
-                self._gauges_locked()
+                gauges = self._gauges_snapshot_locked()
+            if stolen_members:
+                telemetry.count("windows_stolen", stolen_members)
+            self._emit_gauges(gauges)
             self._execute(replica, work)
             with self._cv:
                 replica.busy = False
-                self._gauges_locked()
+                gauges = self._gauges_snapshot_locked()
                 self._cv.notify_all()
+            self._emit_gauges(gauges)
 
     def _steal_locked(self, thief: Replica):
-        """Oldest stealable item from the longest peer queue."""
+        """Oldest stealable item from the longest peer queue. The
+        caller counts windows_stolen after releasing `_cv`."""
         victims = sorted(
             (r for r in self.replicas
              if r is not thief and r.queue),
@@ -383,7 +422,6 @@ class ReplicaPool:
                 if not work.pinned:
                     victim.queue.remove(work)
                     thief.stolen += 1
-                    telemetry.count("windows_stolen", work.members)
                     return work
         return None
 
@@ -440,6 +478,8 @@ class ReplicaPool:
         drained: list[_Work] = []
         target = None
         probe_failed = False
+        promoted: list[int] = []
+        gauges: list = []
         with self._cv:
             replica.failed += 1
             if (work.attempts == 0 and not work.pinned
@@ -481,12 +521,14 @@ class ReplicaPool:
                     })
                     target.queue.append(work)
                     for w in drained:
-                        self._route_locked().queue.append(w)
-                    self._gauges_locked()
+                        self._route_locked(promoted).queue.append(w)
+                    gauges = self._gauges_snapshot_locked()
                     self._cv.notify_all()
         if target is None:
             work.future.set_exception(exc)
             return
+        self._emit_promotions(promoted)
+        self._emit_gauges(gauges)
         telemetry.count("replica_quarantined")
         telemetry.event(
             "replica_quarantined", replica=replica.rid,

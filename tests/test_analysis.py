@@ -360,9 +360,11 @@ def test_cli_analyze_mode(capsys):
 
 def test_determinism_lint_runs_clean():
     """The bit-identity targets carry no wallclock/entropy/hashseed/
-    set-order constructs (modulo the reviewed allowlist)."""
+    set-order constructs (modulo the reviewed allowlist), and the
+    lint's seeded fixtures still trip their expected rules."""
     assert lint_determinism.run_lint() == []
     assert lint_determinism.main([]) == 0
+    assert lint_determinism.main(["--fixtures"]) == 0
 
 
 def test_determinism_lint_catches_synthetic_violations():

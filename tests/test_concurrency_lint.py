@@ -1,0 +1,324 @@
+"""Concurrency analyzer + lockdep witness: the standing gate is
+clean on the repo, every seeded fixture trips its expected C_* code
+with a nonzero exit, the static lock-order graph is cycle-free, both
+lint gates share one JSON report shape, and the runtime witness
+detects inversions / long holds while staying a pure observer.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from pluss_sampler_optimization_tpu.analysis import concurrency
+from pluss_sampler_optimization_tpu.analysis.lint_common import (
+    check_fixtures,
+)
+from pluss_sampler_optimization_tpu.runtime import lockwitness
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+import check_concurrency  # noqa: E402
+import lint_determinism  # noqa: E402
+
+
+# -- the standing gate ------------------------------------------------
+
+
+def test_gate_runs_clean_on_repo():
+    """Zero unreviewed findings across the serving runtime — the
+    same invariant tools/check_concurrency.py enforces in CI."""
+    assert check_concurrency.main([]) == 0
+
+
+def test_repo_lock_graph_is_cycle_free():
+    res = concurrency.analyze_files()
+    assert res.n_files >= 10
+    assert res.n_functions > 50
+    assert not any(v.rule == "C_LOCK_CYCLE" for v in res.violations)
+    # edge pairs are exactly the keys of the site map, sorted
+    assert res.edge_pairs() == sorted(res.edges)
+
+
+def test_inventory_covers_known_primitives():
+    inv = concurrency.analyze_files().inventory
+    lock_ids = {d["id"] for d in inv["locks"]}
+    assert {"RequestExecutor._lock", "BatchScheduler._cv",
+            "ResultCache._lock", "telemetry._lock"} <= lock_ids
+    assert inv["signal_handlers"]  # cli._serve registers handlers
+
+
+def test_fixtures_all_trip_expected_codes():
+    problems = check_fixtures(concurrency.FIXTURES,
+                              concurrency.lint_source)
+    assert problems == []
+    assert check_concurrency.main(["--fixtures"]) == 0
+
+
+@pytest.mark.parametrize("name", sorted(concurrency.FIXTURES))
+def test_each_fixture_fails_the_gate(name, capsys):
+    """The per-fixture acceptance criterion: the gate exits nonzero
+    on every seeded bug."""
+    assert check_concurrency.main(["--fixture", name]) == 1
+    err = capsys.readouterr().err
+    assert concurrency.FIXTURES[name][1] in err
+
+
+def test_unknown_fixture_is_an_error():
+    assert check_concurrency.main(["--fixture", "no_such"]) == 2
+
+
+def test_both_gates_share_report_shape(capsys):
+    """Satellite invariant: lint_determinism and check_concurrency
+    emit the same machine-readable report document."""
+    assert check_concurrency.main(["--json"]) == 0
+    conc = json.loads(capsys.readouterr().out)
+    assert lint_determinism.main(["--json"]) == 0
+    det = json.loads(capsys.readouterr().out)
+    for doc, tool in ((conc, "check_concurrency"),
+                      (det, "lint_determinism")):
+        assert doc["tool"] == tool
+        assert doc["ok"] is True
+        assert doc["violations"] == []
+        assert {"tool", "targets", "violations", "suppressed",
+                "ok"} <= set(doc)
+
+
+def test_allowlist_suppression_is_reviewed_not_silent(capsys):
+    """Every allowlisted id must still exist in the raw analysis —
+    a stale allowlist line means the finding was fixed and the
+    entry should be deleted."""
+    from pluss_sampler_optimization_tpu.analysis import lint_common
+
+    allow = lint_common.read_allowlist(
+        check_concurrency.ALLOWLIST_PATH)
+    raw = {v.id for v in concurrency.analyze_files().violations}
+    assert allow  # the cli signal-handler entry is reviewed-in
+    assert allow <= raw, sorted(allow - raw)
+
+
+# -- the runtime witness ----------------------------------------------
+
+
+@pytest.fixture
+def witness():
+    lockwitness.reset()
+    lockwitness.enable()
+    yield lockwitness
+    lockwitness.disable()
+    lockwitness.reset()
+
+
+def test_factories_return_plain_primitives_when_disabled():
+    assert not lockwitness.enabled()
+    lk = lockwitness.make_lock("T._plain")
+    assert type(lk) is type(threading.Lock())
+    cv = lockwitness.make_condition("T._plaincv")
+    assert isinstance(cv, threading.Condition)
+    # the wrapper-vs-plain decision is taken at creation time: a
+    # lock minted while disabled stays unwitnessed after enable()
+    lockwitness.enable()
+    try:
+        with lk:
+            assert lockwitness.held_names() == ()
+    finally:
+        lockwitness.disable()
+        lockwitness.reset()
+
+
+def test_witness_records_edges_and_detects_inversion(witness):
+    a = witness.make_lock("T._a")
+    b = witness.make_lock("T._b")
+    with a:
+        assert witness.held_names() == ("T._a",)
+        with b:
+            assert witness.held_names() == ("T._a", "T._b")
+    assert ("T._a", "T._b") in witness.observed_edges()
+    assert witness.report()["inversion_count"] == 0
+    with b:
+        with a:  # reverse order: the inversion the witness exists for
+            pass
+    doc = witness.report()
+    assert doc["inversion_count"] == 1
+    assert ("T._b", "T._a") in witness.observed_edges()
+    assert witness.held_names() == ()
+
+
+def test_witness_flags_long_holds(witness):
+    witness.enable(long_hold_s=0.01)
+    lk = witness.make_lock("T._slow")
+    with lk:
+        time.sleep(0.05)
+    doc = witness.report()
+    assert doc["long_hold_count"] >= 1
+    assert any(h["name"] == "T._slow" for h in doc["long_holds"])
+
+
+def test_condition_wait_does_not_count_as_holding(witness):
+    """wait() releases the underlying lock; the witness must unrecord
+    for the wait window — otherwise every batch-scheduler idle wait
+    would read as a long hold."""
+    witness.enable(long_hold_s=0.1)
+    cv = witness.make_condition("T._cv")
+    with cv:
+        cv.wait(timeout=0.3)  # 3x the long-hold bar, all waiting
+        assert witness.held_names() == ("T._cv",)
+    doc = witness.report()
+    assert not any(h["name"] == "T._cv" for h in doc["long_holds"])
+
+
+def test_witness_emit_report_fires_telemetry_events(witness):
+    from pluss_sampler_optimization_tpu.runtime import telemetry
+
+    a = witness.make_lock("E._a")
+    b = witness.make_lock("E._b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    tele = telemetry.enable()
+    try:
+        doc = witness.emit_report()
+    finally:
+        telemetry.disable()
+    assert doc["inversion_count"] == 1
+    assert tele.counters.get("lock_witness_inversions") == 1
+    assert any(e["name"] == "lock_witness_inversion"
+               for e in tele.events)
+
+
+def test_witness_edges_cross_thread(witness):
+    """Inversions between two threads (the real deadlock shape) are
+    caught: T1 takes a->b, T2 takes b->a."""
+    a = witness.make_lock("X._a")
+    b = witness.make_lock("X._b")
+    done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        done.set()
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join(timeout=10)
+    assert done.is_set()
+    with b:
+        with a:
+            pass
+    assert witness.report()["inversion_count"] == 1
+
+
+def test_telemetry_emitted_outside_every_service_lock(
+        witness, monkeypatch, tmp_path):
+    """The satellite-1 regression pin: every telemetry sink call
+    (count/gauge/event fans out to subsystems with their own locks)
+    must fire with ZERO witnessed locks held. This is the deferred-
+    emission contract the fixes in cache.py, executor.py,
+    replicas.py, and recorder.py established — a relapse (emitting
+    under `_lock`/`_cv` again) puts the source lock back on the held
+    stack at sink time and fails here."""
+    from pluss_sampler_optimization_tpu.runtime import (
+        telemetry as tele_mod,
+    )
+    from pluss_sampler_optimization_tpu.runtime.obs import (
+        recorder as obs_recorder,
+    )
+    from pluss_sampler_optimization_tpu.service import (
+        AnalysisRequest,
+        AnalysisService,
+        ResultCache,
+    )
+
+    bad: list = []
+
+    def _probe(fn):
+        def wrapped(*a, **kw):
+            held = lockwitness.held_names()
+            if held:
+                bad.append((fn.__name__, a and a[0], held))
+            return fn(*a, **kw)
+        return wrapped
+
+    monkeypatch.setattr(tele_mod, "count", _probe(tele_mod.count))
+    monkeypatch.setattr(tele_mod, "gauge", _probe(tele_mod.gauge))
+    monkeypatch.setattr(tele_mod, "event", _probe(tele_mod.event))
+
+    tele = tele_mod.enable()
+    rec = obs_recorder.enable(str(tmp_path / "bundles"))
+    try:
+        # cache tier: mem hits, disk hits, puts, LRU evictions
+        cache = ResultCache(cache_dir=str(tmp_path / "store"),
+                            mem_entries=2)
+        for i in range(5):
+            cache.put(f"f{i:02d}" * 32, {"store_version": 1})
+        cache.get("f00" * 32)
+        # executor + replica pool + batcher under real threads; the
+        # poisoned seed drives the replica failure-handling and the
+        # anomaly -> recorder.trigger paths
+        from pluss_sampler_optimization_tpu.service.executor import (
+            default_runner,
+        )
+
+        def flaky_runner(engine, program, machine, request):
+            if request.id == "e-bad":
+                raise RuntimeError("seeded failure")
+            return default_runner(engine, program, machine, request)
+
+        reqs = [
+            AnalysisRequest(model="gemm", n=16, engine="sampled",
+                            ratio=0.2, seed=s, id=f"e-{s}")
+            for s in (0, 1, 2)
+        ]
+        with AnalysisService(max_workers=2, replicas=2,
+                             batch_window_ms=20.0,
+                             runner=flaky_runner) as svc:
+            tickets = [svc.submit(r) for r in reqs]
+            resps = [svc.result(t, timeout=120) for t in tickets]
+            # exact engine => not batchable => the custom runner (and
+            # the replica failure path) actually runs it
+            fail = svc.analyze(
+                AnalysisRequest(model="gemm", n=16, engine="exact",
+                                seed=3, id="e-bad"),
+                timeout=120,
+            )
+        assert all(r.ok for r in resps)
+        assert not fail.ok
+        assert rec.stats()["records_seen"] > 0
+    finally:
+        obs_recorder.disable()
+        tele_mod.disable()
+    assert tele.counters.get("service_cache_evictions") == 3
+    assert bad == [], bad
+    assert witness.report()["inversion_count"] == 0
+
+
+def test_static_graph_superset_of_witnessed_service_run(witness):
+    """Soundness on the real system: serve a few requests through a
+    witnessed AnalysisService; every runtime lock order must already
+    be in the static analyzer's graph, with zero inversions."""
+    from pluss_sampler_optimization_tpu.service import (
+        AnalysisRequest,
+        AnalysisService,
+    )
+
+    reqs = [
+        AnalysisRequest(model="gemm", n=16, engine="sampled",
+                        ratio=0.2, seed=s, id=f"w-{s}")
+        for s in (0, 1)
+    ]
+    with AnalysisService(max_workers=2) as svc:
+        resps = [svc.analyze(r, timeout=120) for r in reqs]
+    assert all(r.ok for r in resps)
+    static = set(concurrency.analyze_files().edge_pairs())
+    assert witness.observed_edges() <= static
+    assert witness.report()["inversion_count"] == 0

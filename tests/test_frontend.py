@@ -406,6 +406,16 @@ def test_fuzz_smoke(seed):
     assert r["accesses"] >= fuzz.MIN_ACCESSES
 
 
+def test_fuzz_batched_and_sharded_bit_identity():
+    """One-seed tier-1 smoke of the batched (run_sampled_multi
+    union bucket) and sharded (run_sampled_sharded, 2-device mesh)
+    contract arms: both must be bit-identical to the solo sampled
+    run. The multi-seed sweep is `tools/fuzz_ir.py --batched
+    --sharded`."""
+    r = fuzz.check_seed(0, sampled=False, batched=True, sharded=True)
+    assert r["ok"], r["errors"]
+
+
 @pytest.mark.slow
 def test_fuzz_deep_with_sampled_drift():
     summary = fuzz.run_seeds(40, sampled=True)

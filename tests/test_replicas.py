@@ -32,7 +32,10 @@ from pluss_sampler_optimization_tpu.config import (
     ResilienceConfig,
     SLOConfig,
 )
-from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime import (
+    lockwitness,
+    telemetry,
+)
 from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
 from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
 from pluss_sampler_optimization_tpu.runtime.obs import (
@@ -227,12 +230,16 @@ def test_concurrent_requests_spread_across_replicas(tmp_path):
     """K=4 distinct concurrent requests at replicas=4 (batching off)
     execute on ≥ 2 distinct replica ids, and every surface — the
     responses, serve `stats`, the ledger aggregate, and
-    check_ledger --stats — reports the same per-replica counts."""
+    check_ledger --stats — reports the same per-replica counts.
+    The replica pool runs under the lockdep witness: zero lock-order
+    inversions, and MRC bytes bit-identical to a witness-off pass."""
     # distinct fingerprints via seed, IDENTICAL shapes via (n, ratio):
     # the spread proof doesn't need per-request recompiles
     reqs = [_sampled_req(seed=s) for s in (1, 2, 3, 4)]
     ledger_path = str(tmp_path / "ledger.jsonl")
     tele = telemetry.enable()
+    lockwitness.reset()
+    lockwitness.enable()
     with AnalysisService(
         cache_dir=str(tmp_path / "store"), ledger_path=ledger_path,
         replicas=4,
@@ -242,7 +249,21 @@ def test_concurrent_requests_spread_across_replicas(tmp_path):
         snap = svc.stats()["executor"]["replicas"]
         health = svc.healthz()
     telemetry.disable()
+    witness = lockwitness.report()
+    lockwitness.disable()
+    lockwitness.reset()
+    assert witness["inversion_count"] == 0, witness["inversions"]
     assert all(r.ok for r in resps)
+    # pure-observer proof: a witness-off pool serves the same
+    # requests with bit-identical MRC bytes
+    with AnalysisService(
+        cache_dir=str(tmp_path / "store_off"), replicas=4,
+    ) as svc_off:
+        off = [svc_off.result(t, timeout=300)
+               for t in [svc_off.submit(r) for r in reqs]]
+    assert all(r.ok for r in off)
+    for a, b in zip(resps, off):
+        assert np.asarray(a.mrc).tobytes() == np.asarray(b.mrc).tobytes()
     rids = {r.replica_id for r in resps}
     assert len(rids) >= 2  # the concurrency proof
     assert all(r in range(4) for r in rids)

@@ -19,7 +19,10 @@ import pytest
 
 from pluss_sampler_optimization_tpu.cli import main
 from pluss_sampler_optimization_tpu.models import REGISTRY, build
-from pluss_sampler_optimization_tpu.runtime import telemetry
+from pluss_sampler_optimization_tpu.runtime import (
+    lockwitness,
+    telemetry,
+)
 from pluss_sampler_optimization_tpu.runtime.io import (
     atomic_write_json,
     atomic_write_text,
@@ -222,7 +225,10 @@ def test_warm_repeat_bit_identical_mrc_zero_executions(tmp_path):
 def test_identical_concurrent_requests_coalesce_to_one_execution():
     """N identical + M distinct requests fired from threads: exactly
     one execution per distinct fingerprint (telemetry dispatch
-    counters), every caller gets the full result."""
+    counters), every caller gets the full result. Runs under the
+    lockdep witness: the thread-hammered service must show zero
+    lock-order inversions and results bit-identical to a witness-off
+    pass."""
     release = threading.Event()
 
     def slow_runner(engine, program, machine, request):
@@ -235,6 +241,8 @@ def test_identical_concurrent_requests_coalesce_to_one_execution():
         + [_req(n=18) for _ in range(4)]
         + [_req(model="mvt", n=12) for _ in range(4)]
     )
+    lockwitness.reset()
+    lockwitness.enable()
     with AnalysisService(max_workers=4, runner=slow_runner) as svc:
         responses = [None] * len(reqs)
 
@@ -256,6 +264,10 @@ def test_identical_concurrent_requests_coalesce_to_one_execution():
         for t in threads:
             t.join(timeout=60)
     telemetry.disable()
+    witness = lockwitness.report()
+    lockwitness.disable()
+    lockwitness.reset()
+    assert witness["inversion_count"] == 0, witness["inversions"]
     assert all(r is not None and r.ok for r in responses)
     assert tele.counters.get("service_exec_started") == 3
     # every non-executing request either joined an in-flight future or
@@ -272,6 +284,14 @@ def test_identical_concurrent_requests_coalesce_to_one_execution():
             assert np.array_equal(r.mrc, base.mrc)
     fps = {r.fingerprint for r in responses}
     assert len(fps) == 3
+    # the witness is a pure observer: the same three fingerprints
+    # served witness-off are bit-identical to the hammered run
+    assert not lockwitness.enabled()
+    with AnalysisService(max_workers=4) as svc:
+        for i in (0, 8, 12):
+            off = svc.analyze(reqs[i])
+            assert np.asarray(off.mrc).tobytes() \
+                == np.asarray(responses[i].mrc).tobytes()
 
 
 def test_deadline_degrades_and_skips_persistent_cache(tmp_path):

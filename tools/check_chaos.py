@@ -67,7 +67,10 @@ from pluss_sampler_optimization_tpu.config import (  # noqa: E402
     FaultConfig,
     ResilienceConfig,
 )
-from pluss_sampler_optimization_tpu.runtime import faults  # noqa: E402
+from pluss_sampler_optimization_tpu.runtime import (  # noqa: E402
+    faults,
+    lockwitness,
+)
 
 TIMEOUT_S = 120.0
 
@@ -458,7 +461,56 @@ def check_overload(seed: int, problems: list, slow: bool) -> None:
             )
 
 
-def run_seed(seed: int, slow: bool) -> list[str]:
+def check_witness_identity(seed: int, problems: list) -> None:
+    """The lock witness must be a pure observer: the same request set
+    served witness-off and witness-on yields bit-identical MRC
+    digests. Runs only when the gate armed the witness (the off-run
+    services are built inside a disable/enable window, so their locks
+    come out plain)."""
+    reqs = _requests(4, seed + 17)
+    lockwitness.disable()
+    try:
+        with _service(None, None, seed) as svc:
+            off = _digests(_run_all(svc, reqs))
+    finally:
+        lockwitness.enable()
+    with _service(None, None, seed) as svc:
+        on = _digests(_run_all(svc, reqs))
+    if on != off:
+        diff = {k: (on[k], off.get(k)) for k in on
+                if on[k] != off.get(k)}
+        problems.append(
+            f"seed {seed}: MRC digests differ witness-on vs "
+            f"witness-off: {diff}"
+        )
+
+
+def check_witness_report(problems: list) -> None:
+    """After every seed ran under the armed witness: no lock-order
+    inversion was observed at runtime, and every observed (held ->
+    acquired) pair is in the static analyzer's lock-order graph — the
+    static graph is a sound superset of reality."""
+    from pluss_sampler_optimization_tpu.analysis import concurrency
+
+    doc = lockwitness.report()
+    if doc["inversion_count"]:
+        problems.append(
+            f"lock witness observed {doc['inversion_count']} "
+            f"lock-order inversion(s): {doc['inversions']}"
+        )
+    static = set(concurrency.analyze_files().edge_pairs())
+    unmodeled = lockwitness.observed_edges() - static
+    if unmodeled:
+        problems.append(
+            "runtime lock orders missing from the static graph "
+            f"(analyzer unsound): {sorted(unmodeled)}"
+        )
+    print(f"check_chaos: witness: {len(doc['edges'])} observed "
+          f"edge(s), {doc['inversion_count']} inversion(s), "
+          f"{len(static)} static edge(s)")
+
+
+def run_seed(seed: int, slow: bool, witness: bool = False) -> list[str]:
     problems: list[str] = []
     tmp = tempfile.mkdtemp(prefix=f"check_chaos_s{seed}_")
     try:
@@ -469,6 +521,8 @@ def run_seed(seed: int, slow: bool) -> list[str]:
         check_hedging(seed, problems)
         check_serve_line_faults(seed, problems)
         check_overload(seed, problems, slow)
+        if witness:
+            check_witness_identity(seed, problems)
         print(f"check_chaos: seed {seed}: "
               f"{'OK' if not problems else 'FAIL'} "
               f"({time.perf_counter() - t0:.1f}s)")
@@ -487,13 +541,30 @@ def main(argv=None) -> int:
     ap.add_argument("--slow", action="store_true",
                     help="include the overload soak with pinned SLO "
                     "numbers")
+    ap.add_argument("--no-witness", action="store_true",
+                    help="run without the lockdep witness (skips the "
+                    "inversion/superset and on-vs-off identity checks)")
     args = ap.parse_args(argv)
     if faults.get() is not None:
         # a leftover injector would corrupt every phase's baseline
         faults.uninstall()
+    witness = not args.no_witness
+    was_enabled = lockwitness.enabled()
+    if witness:
+        lockwitness.reset()
+        lockwitness.enable()
     problems: list[str] = []
-    for seed in range(args.seeds):
-        problems += run_seed(seed, args.slow)
+    try:
+        for seed in range(args.seeds):
+            problems += run_seed(seed, args.slow, witness=witness)
+        if witness:
+            check_witness_report(problems)
+    finally:
+        # leave the process as found: in-process callers
+        # (tests/test_chaos.py) must not inherit an armed witness
+        if witness and not was_enabled:
+            lockwitness.disable()
+            lockwitness.reset()
     for p in problems:
         print(f"check_chaos: FAIL: {p}", file=sys.stderr)
     print(f"check_chaos: {args.seeds} seed(s), "

@@ -6,10 +6,19 @@ bit-identity vs the numpy oracle, sampled-engine MRC drift bound,
 and rejection-with-diagnostic for every invalid mutant.
 
     python tools/fuzz_ir.py [--seeds N] [--start-seed S]
-        [--ratio R] [--drift-max D] [--mutants M] [--json] [-v]
+        [--ratio R] [--drift-max D] [--mutants M]
+        [--batched] [--sharded] [--json] [-v]
+
+`--batched` additionally pushes every seed through the batched
+engine (sampler/sampled.py::run_sampled_multi, the BatchScheduler's
+union-bucket path) in a mixed 3-job bucket and requires bit-identity
+to the solo run; `--sharded` does the same through
+parallel/sharded.py::run_sampled_sharded on a 2-device virtual CPU
+mesh (pinned via _platform.force_virtual_cpu before jax comes up).
 
 Exit code: nonzero on ANY oracle mismatch, drift violation, accepted
-mutant, or parser crash — so the sweep can run as a standing gate.
+mutant, batched/sharded divergence, or parser crash — so the sweep
+can run as a standing gate.
 Failures print the seed and the exact contract clause violated;
 re-run a single seed with `--seeds 1 --start-seed S` to reproduce
 (the generator is fully deterministic per seed).
@@ -29,6 +38,9 @@ sys.path.insert(
 
 
 def main(argv=None) -> int:
+    # importing the fuzz module is backend-free (engines load lazily
+    # inside check_seed), so the --sharded platform pin below still
+    # lands before jax's first backend touch
     from pluss_sampler_optimization_tpu.frontend import fuzz
 
     ap = argparse.ArgumentParser(
@@ -43,11 +55,24 @@ def main(argv=None) -> int:
                     help="max |MRC_sampled - MRC_oracle| allowed")
     ap.add_argument("--mutants", type=int, default=4,
                     help="invalid mutants per seed")
+    ap.add_argument("--batched", action="store_true",
+                    help="also check run_sampled_multi bit-identity "
+                         "vs solo per seed")
+    ap.add_argument("--sharded", action="store_true",
+                    help="also check run_sampled_sharded bit-identity "
+                         "vs solo per seed (2-device virtual mesh)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="one line per seed")
     args = ap.parse_args(argv)
+
+    if args.sharded:
+        from pluss_sampler_optimization_tpu._platform import (
+            force_virtual_cpu,
+        )
+
+        force_virtual_cpu(8)
 
     def progress(r):
         if args.verbose:
@@ -62,6 +87,7 @@ def main(argv=None) -> int:
     summary = fuzz.run_seeds(
         args.seeds, start=args.start_seed, ratio=args.ratio,
         drift_max=args.drift_max, n_mutants=args.mutants,
+        batched=args.batched, sharded=args.sharded,
         progress=progress,
     )
     summary["wall_s"] = round(time.time() - t0, 1)

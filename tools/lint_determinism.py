@@ -28,21 +28,31 @@ Violation ids are `relpath::qualname::rule`; lines in
 tools/lint_determinism_allow.txt (one id per line, '#' comments)
 suppress a finding after human review. tests/test_analysis.py runs
 the lint from tier-1 (clean run required) and checks it still
-catches synthetic violations.
+catches synthetic violations. Driver plumbing (Violation, allowlist,
+JSON report shape, the `--fixtures` self-test convention) is shared
+with tools/check_concurrency.py via analysis/lint_common.py.
 
-    python tools/lint_determinism.py [--list-targets]
+    python tools/lint_determinism.py
+        [--list-targets] [--json] [--fixtures]
 """
 
 from __future__ import annotations
 
 import argparse
 import ast
-import dataclasses
 import os
 import sys
+import textwrap
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from pluss_sampler_optimization_tpu.analysis import (  # noqa: E402
+    lint_common,
+)
+from pluss_sampler_optimization_tpu.analysis.lint_common import (  # noqa: E402
+    Violation,
 )
 
 PKG = "pluss_sampler_optimization_tpu"
@@ -73,22 +83,6 @@ _WALLCLOCK = {"time.time", "time.time_ns", "time.perf_counter",
 _ENTROPY_EXACT = {"os.urandom", "uuid.uuid4"}
 _ENTROPY_PREFIX = ("random.", "np.random.", "numpy.random.",
                    "secrets.")
-
-
-@dataclasses.dataclass(frozen=True)
-class Violation:
-    path: str  # repo-relative
-    qualname: str
-    rule: str
-    line: int
-    detail: str
-
-    @property
-    def id(self) -> str:
-        return f"{self.path}::{self.qualname}::{self.rule}"
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line} [{self.rule}] {self.detail}"
 
 
 def _dotted(node: ast.AST) -> str | None:
@@ -197,15 +191,33 @@ def lint_source(source: str, path: str,
 
 
 def read_allowlist(path: str = ALLOWLIST_PATH) -> set[str]:
-    if not os.path.exists(path):
-        return set()
-    out = set()
-    with open(path) as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if line:
-                out.add(line)
-    return out
+    return lint_common.read_allowlist(path)
+
+
+#: seeded bad-pattern fixtures, one per rule, in the shared
+#: lint_common.check_fixtures convention (--fixtures / tier-1)
+FIXTURES = {
+    "wallclock": (textwrap.dedent("""
+        import time
+
+        def fingerprint(payload):
+            return (payload, time.time())
+    """), "wallclock"),
+    "entropy": (textwrap.dedent("""
+        import random
+
+        def salt():
+            return random.random()
+    """), "entropy"),
+    "hashseed": (textwrap.dedent("""
+        def key(payload):
+            return hash(payload)
+    """), "hashseed"),
+    "set_order": (textwrap.dedent("""
+        def fold(refs):
+            return [r for r in set(refs)]
+    """), "set-order"),
+}
 
 
 def run_lint(repo_root: str | None = None,
@@ -232,17 +244,35 @@ def main(argv=None) -> int:
         description="determinism lint over the bit-identity hot spots"
     )
     ap.add_argument("--list-targets", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report (shared shape with "
+                         "tools/check_concurrency.py)")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="self-test: every seeded bad pattern must "
+                         "trip its expected rule")
     args = ap.parse_args(argv)
     if args.list_targets:
         for rel, qual in TARGETS:
             print(f"{rel}" + (f"::{qual}" if qual else ""))
         return 0
-    violations = run_lint()
-    for v in violations:
-        print(str(v), file=sys.stderr)
-    n = len(TARGETS)
-    print(f"determinism lint: {n} targets, {len(violations)} "
-          "violation(s)")
+    if args.fixtures:
+        problems = lint_common.check_fixtures(
+            FIXTURES, lambda s, p: lint_source(s, p)
+        )
+        for p in problems:
+            print(f"FIXTURE FAIL: {p}", file=sys.stderr)
+        print(f"lint_determinism --fixtures: {len(FIXTURES)} "
+              f"fixture(s), {len(problems)} problem(s)")
+        return 1 if problems else 0
+    allow = read_allowlist()
+    all_violations = run_lint(allowlist=set())
+    violations, suppressed = lint_common.split_allowed(
+        all_violations, allow
+    )
+    doc = lint_common.report_doc(
+        "lint_determinism", len(TARGETS), violations, suppressed
+    )
+    lint_common.print_report(doc, args.json)
     return 1 if violations else 0
 
 
