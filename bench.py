@@ -667,6 +667,18 @@ def main() -> int:
                     "to the script directory; the evidence JSON "
                     "cross-references it and `cli stats` / "
                     "tools/check_ledger.py consume it ('' disables)")
+    ap.add_argument("--kernel-backend", default=None,
+                    choices=["auto", "xla", "pallas", "native"],
+                    help="sampled engine: classify+histogram kernel "
+                    "for the headline runs (SamplerConfig."
+                    "kernel_backend; default auto). The "
+                    "kernel_roofline extra measures all backends "
+                    "regardless")
+    ap.add_argument("--require-accelerator", action="store_true",
+                    help="exit nonzero instead of benchmarking on the "
+                    "CPU backend (probe fallback or a CPU-only host): "
+                    "for drivers whose numbers are only meaningful as "
+                    "accelerator evidence")
     ap.add_argument("--extras-spent", type=float, default=0.0,
                     help=argparse.SUPPRESS)  # internal: wall seconds
     # already burned by a predecessor process before an accel-hang
@@ -738,6 +750,25 @@ def main() -> int:
         cached=probe_was_cached,
         attempts=len([e for e in probe_evidence if "attempt" in e]),
     )
+    # the fallback must never be silent: a 0/1 gauge in every sidecar
+    # (greppable across rounds) plus a once-per-process stderr banner —
+    # a CPU number filed as accelerator evidence poisons the ledger
+    telemetry.gauge("device_fallback", 1.0 if device_fallback else 0.0)
+    if device_fallback:
+        telemetry.warn_once(
+            "device_fallback",
+            "accelerator probe/init failed — this bench run executes "
+            "on the CPU backend; its numbers are NOT accelerator "
+            "evidence (pass --require-accelerator to refuse instead)",
+        )
+        if args.require_accelerator:
+            print(
+                "bench: --require-accelerator set but the accelerator "
+                "backend is unavailable (probe fallback); refusing to "
+                "benchmark on CPU",
+                file=sys.stderr,
+            )
+            return 2
 
     if device_fallback:
         # The env may pin JAX_PLATFORMS to an accelerator plugin from
@@ -801,7 +832,10 @@ def main() -> int:
                 f"(known: {', '.join(sorted(REGISTRY))})"
             )
     prog = REGISTRY[args.model](args.n)
-    cfg = SamplerConfig(ratio=args.ratio, seed=args.seed)
+    cfg_kw = {}
+    if args.kernel_backend is not None:
+        cfg_kw["kernel_backend"] = args.kernel_backend
+    cfg = SamplerConfig(ratio=args.ratio, seed=args.seed, **cfg_kw)
 
     def timed_engine_run():
         """One timed run; returns (state, work units for the rate)."""
@@ -885,6 +919,15 @@ def main() -> int:
     dev = stamps["dev"]
     init_s = stamps["init_s"]
     warmup_s = stamps["warmup_s"]
+    if args.require_accelerator and str(dev.platform) == "cpu":
+        # probe passed (or was disabled) but the claimed device is
+        # still CPU — e.g. a CPU-only host with --device-timeout 0
+        print(
+            "bench: --require-accelerator set but the claimed device "
+            f"is {dev.platform}; refusing to benchmark on CPU",
+            file=sys.stderr,
+        )
+        return 2
 
     times = []
     rep_stats = []
@@ -1206,7 +1249,15 @@ def main() -> int:
             rf.update({"model": args.model, "n": n_rf})
             fused_results: dict = {}
             for label, fuse in (("fused", True), ("unfused", False)):
-                fcfg = _dc.replace(cfg, fuse_refs=fuse)
+                # kernel_backend pinned: this extra isolates the
+                # fusion axis, and the per-ref RESULT comparison below
+                # needs both legs on the same kernel representation
+                # (auto resolves the unfused CPU leg to native, whose
+                # per-ref noshare keys are ladder-binned — same folded
+                # state, different raw result objects)
+                fcfg = _dc.replace(
+                    cfg, fuse_refs=fuse, kernel_backend="xla"
+                )
                 warmup(fprog, machine, fcfg)
                 d0 = tele.counters.get("dispatches", 0)
                 t0 = time.perf_counter()
@@ -1242,6 +1293,149 @@ def main() -> int:
             )
         except Exception as e:  # never sink the headline metric
             rf["error"] = repr(e)
+
+    # Kernel roofline: the sampled hot loop (classify + histogram)
+    # measured per kernel backend on the same config — wall split into
+    # per-stage span seconds (draw/dispatch/fetch/merge), dispatch
+    # deltas, modeled bytes/FLOPs for the classify traffic, and the
+    # MRC digest so identity across backends is pinned in the same
+    # evidence row as the speedup. The model-sized rows run the fused
+    # XLA baseline and the native CPU fast path; interpret-mode pallas
+    # cold-compiles one pallas_call per ref (~10-60s EACH on CPU), so
+    # the model-sized pallas row only runs when --kernel-backend
+    # pallas asks for it — the three-way digest identity is instead
+    # pinned on a bounded 2-ref program below, every run.
+    if extras_budget_left("kernel_roofline", extra):
+        kr: dict = {}
+        extra["kernel_roofline"] = kr
+        try:
+            import dataclasses as _dc
+
+            from pluss_sampler_optimization_tpu.ir import (
+                Loop,
+                ParallelNest,
+                Program,
+                Ref,
+            )
+            from pluss_sampler_optimization_tpu.runtime.obs import (
+                ledger as obs_ledger,
+            )
+
+            def _kr_digest(state):
+                T = machine.thread_num
+                return obs_ledger.mrc_digest(
+                    aet_mrc(cri_distribute(state, T, T), machine)
+                )
+
+            _STAGES = ("draw", "dispatch", "fetch", "merge")
+
+            def _kr_measure(kprog, kcfg, depth):
+                """One warmed + one timed run: wall, per-stage span
+                seconds, dispatch deltas, modeled traffic, digest."""
+                run_sampled(kprog, machine, kcfg)  # warm: compile/build
+                marks = {s: len(tele.find_spans(s)) for s in _STAGES}
+                d0 = tele.counters.get("dispatches", 0)
+                dn0 = tele.counters.get("dispatches_native", 0)
+                t0 = time.perf_counter()
+                kstate, kres = run_sampled(kprog, machine, kcfg)
+                wall = time.perf_counter() - t0
+                stage_s = {
+                    s: round(sum(
+                        sp.wall_s for sp in tele.find_spans(s)[marks[s]:]
+                    ), 4)
+                    for s in _STAGES
+                }
+                samples = sum(r.n_samples for r in kres)
+                # modeled per-sample classify traffic: 8B key in, 8B
+                # packed + 1B found out, ~8B amortized histogram
+                # update; ~4 ops per decode level + ~16 classify ops.
+                # Crude by design — it exists to place the measured
+                # rates on a roofline, not to be a simulator.
+                bytes_ = samples * 25
+                ops = samples * (4 * depth + 16)
+                return {
+                    "wall_s": round(wall, 4),
+                    "stage_s": stage_s,
+                    # everything that is not drawing keys IS the hot
+                    # loop (classify+reduce+merge, incl. dispatch
+                    # overhead — the quantity the backends compete on)
+                    "hot_loop_s": round(
+                        max(1e-9, wall - stage_s["draw"]), 4
+                    ),
+                    "samples": samples,
+                    "samples_per_s": (
+                        round(samples / wall, 1) if wall > 0 else None
+                    ),
+                    "dispatches": int(
+                        tele.counters.get("dispatches", 0) - d0
+                    ),
+                    "dispatches_native": int(
+                        tele.counters.get("dispatches_native", 0) - dn0
+                    ),
+                    "modeled_bytes": int(bytes_),
+                    "modeled_flops": int(ops),
+                    "arith_intensity": round(ops / max(1, bytes_), 3),
+                    "mrc_digest": _kr_digest(kstate),
+                }
+
+            n_kr = min(args.n, 512)
+            kprog = (prog if n_kr == args.n
+                     else REGISTRY[args.model](n_kr))
+            kr_depth = max(len(nst.loops) for nst in kprog.nests)
+            kr.update({"model": args.model, "n": n_kr,
+                       "ratio": args.ratio})
+            backends = ["xla", "native"]
+            if args.kernel_backend == "pallas":
+                backends.append("pallas")
+            rows: dict = {}
+            kr["backends"] = rows
+            for b in backends:
+                try:
+                    kcfg = (
+                        _dc.replace(cfg, kernel_backend="xla",
+                                    fuse_refs=True)
+                        if b == "xla"  # the r05 fused-XLA baseline
+                        else _dc.replace(cfg, kernel_backend=b)
+                    )
+                    rows[b] = _kr_measure(kprog, kcfg, kr_depth)
+                except Exception as e:
+                    rows[b] = {"error": repr(e)}
+            ok_rows = {b: r for b, r in rows.items()
+                       if "hot_loop_s" in r}
+            if "xla" in ok_rows:
+                base_s = ok_rows["xla"]["hot_loop_s"]
+                for b, r in ok_rows.items():
+                    if b != "xla":
+                        r["hot_loop_speedup_vs_xla"] = round(
+                            base_s / r["hot_loop_s"], 2
+                        )
+            kr["digests_identical"] = len(
+                {r["mrc_digest"] for r in ok_rows.values()}
+            ) <= 1
+            # three-way digest identity (xla vs pallas vs native) on a
+            # bounded 2-ref program: one pallas_call to cold-compile,
+            # so the parity pin costs seconds, not the minutes a full
+            # model would
+            mini = Program(name="roofline-mini", nests=(ParallelNest(
+                loops=(Loop(8), Loop(8)),
+                refs=(
+                    Ref("A0", "A", level=1, coeffs=(8, 1)),
+                    Ref("B0", "B", level=1, coeffs=(0, 1),
+                        share_threshold=9),
+                ),
+            ),))
+            digs = {}
+            for b in ("xla", "pallas", "native"):
+                mstate, _mres = run_sampled(
+                    mini, machine, _dc.replace(cfg, kernel_backend=b)
+                )
+                digs[b] = _kr_digest(mstate)
+            kr["digest_parity"] = {
+                "model": "roofline-mini", "n": 8, "digests": digs,
+                "identical": len(set(digs.values())) == 1,
+            }
+        except Exception as e:  # never sink the headline metric
+            kr["error"] = repr(e)
 
     # Request-serving latency: the analysis service's cold-vs-warm
     # story measured on this host — one small exact request cold (the
@@ -1666,6 +1860,10 @@ def main() -> int:
                 "n": args.n,
                 "latency_s": round(t_tpu, 6),
                 "device": str(dev.platform),
+                # rows from a probe-fallback run are self-identifying:
+                # longitudinal consumers (cli stats, the SLO sentinel)
+                # must never mistake a CPU number for device evidence
+                "device_fallback": bool(device_fallback),
                 "mrc_l1_err": extra.get("mrc_l1_err"),
                 "mrc_digest": extra.get("mrc_digest"),
             })

@@ -203,6 +203,8 @@ def _run_engine(engine: str, program, machine, args):
             kw["device_draw"] = args.device_draw
         if args.fuse_refs is not None:  # None = keep config default
             kw["fuse_refs"] = args.fuse_refs
+        if args.kernel_backend is not None:  # None = auto
+            kw["kernel_backend"] = args.kernel_backend
         if args.pipeline_depth is not None:
             kw["pipeline_depth"] = args.pipeline_depth
         cfg = SamplerConfig(ratio=args.ratio, seed=args.seed, **kw)
@@ -323,6 +325,18 @@ def main(argv=None) -> int:
                     "ON off-CPU, OFF on CPU; results are bit-identical "
                     "either way — --no-fuse-refs keeps the per-ref "
                     "serial loop as the parity oracle)")
+    ap.add_argument("--kernel-backend", default=None,
+                    choices=["auto", "xla", "pallas", "native"],
+                    help="sampled engine: which classify+histogram "
+                    "kernel runs the hot loop — xla (scan/fused jit, "
+                    "the parity oracle), pallas (fused on-chip "
+                    "histogram kernel, interpret mode on CPU), native "
+                    "(SIMD C++ batched classify+reduce via ctypes, "
+                    "CPU only), or auto (default: native on CPU when "
+                    "the shared library builds, xla otherwise). All "
+                    "backends produce bit-identical MRCs; the choice "
+                    "stays out of the request fingerprint like "
+                    "--fuse-refs")
     ap.add_argument("--pipeline-depth", type=int, default=None,
                     help="sampled engine: max in-flight dispatches "
                     "awaiting their device->host fetch before the "
@@ -842,6 +856,11 @@ def main(argv=None) -> int:
             "--device-draw applies to the sampled/sharded engines "
             "only (the exact engines do not sample)"
         )
+    if args.kernel_backend is not None and engine != "sampled":
+        raise SystemExit(
+            "--kernel-backend applies to --engine sampled only (the "
+            "sharded engine picks its kernels per mesh axis)"
+        )
     if args.diff_against:
         if args.mode not in ("acc", "sample"):
             raise SystemExit(
@@ -1042,6 +1061,7 @@ def _request_from_args(args, engine):
         runtime=args.runtime, threads=args.threads, chunk=args.chunk,
         ratio=args.ratio, seed=args.seed, device_draw=args.device_draw,
         fuse_refs=args.fuse_refs, pipeline_depth=args.pipeline_depth,
+        kernel_backend=args.kernel_backend,
         program=getattr(args, "_program_doc", None),
         deadline_s=args.deadline_s,
     )

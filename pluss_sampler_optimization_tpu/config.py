@@ -117,6 +117,26 @@ class SamplerConfig:
     # vmap-safe sorted merge costs more than the dispatches it saves
     # (measured ~1.3x per element, gemm N=1024).
     fuse_refs: bool | None = None
+    # Which classify+histogram kernel implementation the sampled
+    # engine's hot loop runs: "xla" (the scan/fused jit kernels,
+    # the parity oracle), "pallas" (ops/pallas_sampled.py — the
+    # draw-stream classify + comparison-ladder pow2 accumulation in
+    # one on-chip kernel; interpret mode on CPU), "native" (the
+    # SIMD batched classify+histogram entry in native/, CPU only,
+    # via ctypes), or None/"auto" = "xla". Auto deliberately does NOT
+    # pick native-on-CPU: the hist backends ladder-bin noshare reuse
+    # inside the per-ref RESULT objects, and several standing
+    # contracts compare those raw results across code paths
+    # (fused-vs-serial, batched-vs-solo, checkpoint replay) that
+    # would otherwise resolve differently — so "native"/"pallas" are
+    # explicit per-call opt-ins whose callers consume folded states.
+    # All three backends fold to bit-identical PRIStates/MRCs (pow2
+    # binning is exact over integer counts; sub-1 and share reuse
+    # ride an exact residual-pair stream), so like fuse_refs this is
+    # a pure speed knob and stays OUT of the request fingerprint.
+    # v2 raw-noshare runs force "xla" (the hist backends bin noshare
+    # by construction).
+    kernel_backend: str | None = None
     # Persistent XLA compilation cache directory (satellite of the
     # replica-pool PR): when set, the sampled entry points wire it into
     # jax.config ("jax_compilation_cache_dir") with the minimum

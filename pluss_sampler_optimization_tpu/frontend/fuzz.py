@@ -300,7 +300,8 @@ def _states_equal(a, b, thread_num: int) -> bool:
 def check_seed(seed: int, ratio: float = RATIO,
                drift_max: float = DRIFT_MAX,
                n_mutants: int = 4, sampled: bool = True,
-               batched: bool = False, sharded: bool = False) -> dict:
+               batched: bool = False, sharded: bool = False,
+               kernel_backends: tuple = ()) -> dict:
     """Run the full contract for one seed; returns a result dict with
     `ok` plus per-check fields (never raises on a contract failure —
     failures land in `errors` so a sweep reports them all).
@@ -316,7 +317,16 @@ def check_seed(seed: int, ratio: float = RATIO,
     the solo run and job 2 bit-identical to job 0. `sharded=True`
     runs run_sampled_sharded on a 2-device mesh (the caller must have
     pinned a multi-device platform, e.g. force_virtual_cpu) and
-    requires bit-identity to solo. Both imply a solo sampled run."""
+    requires bit-identity to solo. Both imply a solo sampled run.
+
+    `kernel_backends` re-runs the solo sampled config once per named
+    backend ("xla" | "pallas" | "native") and requires each run's
+    PRIState AND folded MRC bit-identical to the solo run — the solo
+    run is itself drift-checked against the numpy oracle, so every
+    backend is transitively pinned to the oracle. (An explicitly
+    requested but unavailable backend falls back to xla with a
+    warn_once, per _resolve_kernel_backend; the identity check then
+    passes trivially.) Implies a solo sampled run."""
     from ..oracle.numpy_ref import run_numpy
     from ..sampler.periodic import run_exact
 
@@ -343,7 +353,7 @@ def check_seed(seed: int, ratio: float = RATIO,
         errors.append("exact: PRIState/MRC not bit-identical to oracle")
 
     drift = 0.0
-    if sampled or batched or sharded:
+    if sampled or batched or sharded or kernel_backends:
         from ..config import SamplerConfig
         from ..sampler.sampled import run_sampled
 
@@ -356,6 +366,18 @@ def check_seed(seed: int, ratio: float = RATIO,
         if sampled and drift > drift_max:
             errors.append(
                 f"sampled: MRC drift {drift:.3f} exceeds {drift_max}")
+
+    for backend in kernel_backends:
+        import dataclasses as _dc
+
+        state_b, _ = run_sampled(
+            program, machine, _dc.replace(cfg, kernel_backend=backend))
+        if (not _states_equal(state_b, state, machine.thread_num)
+                or _fold_mrc(state_b, machine).tobytes()
+                != mrc_sampled.tobytes()):
+            errors.append(
+                f"kernel_backend={backend}: PRIState/MRC not "
+                "bit-identical to solo")
 
     if batched:
         from ..sampler.sampled import run_sampled_multi
@@ -428,7 +450,8 @@ def check_seed(seed: int, ratio: float = RATIO,
 def run_seeds(n: int, start: int = 0, ratio: float = RATIO,
               drift_max: float = DRIFT_MAX, n_mutants: int = 4,
               sampled: bool = True, batched: bool = False,
-              sharded: bool = False, progress=None) -> dict:
+              sharded: bool = False, kernel_backends: tuple = (),
+              progress=None) -> dict:
     """Sweep seeds [start, start+n); summary dict with every failing
     seed's result embedded (empty `failures` == clean sweep)."""
     failures = []
@@ -436,7 +459,8 @@ def run_seeds(n: int, start: int = 0, ratio: float = RATIO,
     for seed in range(start, start + n):
         r = check_seed(seed, ratio=ratio, drift_max=drift_max,
                        n_mutants=n_mutants, sampled=sampled,
-                       batched=batched, sharded=sharded)
+                       batched=batched, sharded=sharded,
+                       kernel_backends=kernel_backends)
         if worst is None or r["sampled_drift"] > worst["sampled_drift"]:
             worst = r
         if not r["ok"]:

@@ -63,6 +63,7 @@ def _load() -> ctypes.CDLL:
         _build_error = f"native runtime unavailable: {e}"
         raise RuntimeError(_build_error) from e
     lib.pluss_run.restype = ctypes.c_int64
+    lib.pluss_classify_reduce.restype = ctypes.c_int64
     _lib = lib
     return lib
 
@@ -81,6 +82,57 @@ def _i64(a) -> np.ndarray:
 
 def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def classify_reduce(
+    packed, found, noshare_bins: np.ndarray, mask=None,
+    share_cap: int = 64,
+):
+    """SIMD batched classify+histogram reduction for the sampled
+    engine's CPU fast path (pluss_classify_reduce).
+
+    `packed`/`found` are one classified chunk (the "raw" kernel form's
+    outputs, already on the host); `noshare_bins` is the caller's
+    per-ref (65,) int64 accumulator (64 pow2 bins + cold at [64]) that
+    the C pass ADDS into; `mask` (optional bool array) marks valid
+    elements. Share samples and sub-1 noshare samples come back as
+    exact sorted (packed key, count) pairs for decode_pairs. Regrows
+    the pair buffers internally on capacity overflow (the C side
+    writes nothing on overflow, so a re-call cannot double-count).
+
+    Returns (keys, counts, share_cap, regrows): the trimmed pair
+    arrays, the (possibly grown) capacity to reuse for the next chunk,
+    and how many regrow re-calls happened (for capacity_regrows).
+    """
+    lib = _load()
+    packed = _i64(packed)
+    found_u8 = np.ascontiguousarray(np.asarray(found, dtype=np.uint8))
+    n = packed.shape[0]
+    if found_u8.shape[0] != n:
+        raise ValueError("packed/found length mismatch")
+    assert noshare_bins.dtype == np.int64 and (
+        noshare_bins.shape == (_NOSHARE_SLOTS,)
+    )
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    mask_ptr = None
+    if mask is not None:
+        mask_u8 = np.ascontiguousarray(np.asarray(mask, dtype=np.uint8))
+        if mask_u8.shape[0] != n:
+            raise ValueError("packed/mask length mismatch")
+        mask_ptr = mask_u8.ctypes.data_as(u8p)
+    regrows = 0
+    while True:
+        keys = np.empty(share_cap, dtype=np.int64)
+        counts = np.empty(share_cap, dtype=np.int64)
+        sz = lib.pluss_classify_reduce(
+            _ptr(packed), found_u8.ctypes.data_as(u8p), mask_ptr,
+            ctypes.c_int64(n), _ptr(noshare_bins), _ptr(keys),
+            _ptr(counts), ctypes.c_int64(share_cap),
+        )
+        if sz <= share_cap:
+            return keys[:sz], counts[:sz], share_cap, regrows
+        regrows += 1
+        share_cap = max(share_cap * 4, int(sz))
 
 
 def run_serial_native(

@@ -305,4 +305,66 @@ int64_t pluss_run(
       share_out, share_count_out, share_cap, per_tid_accesses);
 }
 
+// Batched classify+histogram reduction: the sampled engine's CPU fast
+// path (SamplerConfig.kernel_backend = "native"/auto). The classify
+// stays in XLA (sampled.py's "raw" kernel form emits packed keys +
+// found mask); this single -O3/-march=native pass replaces the
+// sort-based unique reduction, which dominates the chunk wall on a
+// host core. Semantics mirror sampled.py::decode_pairs +
+// fold_results exactly:
+//
+//   packed = reuse * 16 + slot  (slot 15 = noshare; arithmetic
+//   right-shift / low-mask reproduce Python's floored divmod for
+//   negative keys)
+//
+// - noshare with reuse >= 1: pow2 bin 63 - clz(reuse) in
+//   noshare_bins[0..63] (fold_results re-bins 2^e to 2^e, so the
+//   folded state is bit-identical to the raw-key stream);
+// - cold (!found): noshare_bins[64];
+// - everything else (share slots, and noshare with reuse < 1, which
+//   hist_update keeps raw): an exact residual (key, count) map.
+//
+// mask may be null (every element valid). Returns the residual pair
+// count; when it exceeds share_cap NOTHING is written (no partial
+// accumulation — a regrown re-call must not double-count) and the
+// caller re-calls with bigger buffers. On success noshare_bins is
+// ACCUMULATED into (callers keep one per-ref array across chunks)
+// and the pairs are written key-sorted.
+int64_t pluss_classify_reduce(
+    const int64_t* packed, const uint8_t* found, const uint8_t* mask,
+    int64_t n,
+    int64_t* noshare_bins,  // (65,): 64 pow2 bins + cold at [64]
+    int64_t* share_keys, int64_t* share_counts, int64_t share_cap) {
+  std::array<int64_t, kNoShareSlots> local{};
+  std::unordered_map<int64_t, int64_t> residual;
+  for (int64_t i = 0; i < n; ++i) {
+    if (mask != nullptr && mask[i] == 0) continue;
+    if (found[i] == 0) {
+      ++local[kColdBin];
+      continue;
+    }
+    const int64_t p = packed[i];
+    const int64_t reuse = p >> 4;
+    const int64_t slot = p & 15;
+    if (slot == 15 && reuse >= 1) {
+      ++local[63 - __builtin_clzll(static_cast<uint64_t>(reuse))];
+    } else {
+      ++residual[p];
+    }
+  }
+  const int64_t sz = static_cast<int64_t>(residual.size());
+  if (sz > share_cap) return sz;
+  for (int k = 0; k < kNoShareSlots; ++k) noshare_bins[k] += local[k];
+  std::vector<std::pair<int64_t, int64_t>> pairs(residual.begin(),
+                                                 residual.end());
+  std::sort(pairs.begin(), pairs.end());
+  int64_t w = 0;
+  for (const auto& kv : pairs) {
+    share_keys[w] = kv.first;
+    share_counts[w] = kv.second;
+    ++w;
+  }
+  return sz;
+}
+
 }  // extern "C"

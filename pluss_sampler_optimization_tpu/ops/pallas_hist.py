@@ -69,20 +69,15 @@ def _hist_kernel(hi_ref, lo_ref, w_ref, out_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def pow2_hist(values, weights, interpret: bool = False):
-    """(64,) int64 histogram of floor(log2(x)) weighted by `weights`.
+def _ladder_counts(values, w, interpret: bool = False):
+    """(64,) int64 monotone threshold counts c_k = sum w[x >= 2^k].
 
-    `values` int64 (> 0 where weights are nonzero); `weights` are added
-    per entry like exp_hist (bool masks and int32-range counts; the
-    kernel accumulates per-lane partials in int32 across ALL grid
-    steps of a call, so keep per-call weight totals below 2^31).
-    Equivalent to ops/histogram.py::exp_hist within that range.
-    """
+    One pallas call; `w` must be int32 with per-lane totals below
+    2^31 (the caller's job — pow2_hist's widened path splits weights
+    into 16-bit planes and chunks the grid to guarantee it)."""
     values = values.ravel().astype(jnp.int64)
-    w = weights.ravel().astype(jnp.int32)
+    w = w.ravel().astype(jnp.int32)
     n = values.shape[0]
-    if n == 0:
-        return jnp.zeros(N_BINS, dtype=jnp.int64)
     block = _BLOCK_ROWS * _LANES
     pad = (-n) % block
     if pad:
@@ -110,7 +105,64 @@ def pow2_hist(values, weights, interpret: bool = False):
         interpret=interpret,
     )(hi, lo, w2)
 
-    c = jnp.sum(partial, axis=1, dtype=jnp.int64)
+    return jnp.sum(partial, axis=1, dtype=jnp.int64)
+
+
+# widened-path super-chunk: at most 2048 grid steps per pallas call,
+# so a 16-bit weight plane's per-lane int32 partial stays below
+# 2048 * 8 rows * 65535 < 2^31 regardless of input size
+_WIDE_CHUNK = _BLOCK_ROWS * _LANES * 2048
+
+# the per-call weight-total budget of the fast path's int32 partials
+_FAST_LIMIT = 1 << 31
+
+
+def pow2_hist(values, weights, interpret: bool = False,
+              widen: bool | None = None):
+    """(64,) int64 histogram of floor(log2(x)) weighted by `weights`.
+
+    `values` int64 (> 0 where weights are nonzero); `weights` are
+    added per entry like exp_hist (bool masks and int32-range
+    counts). Equivalent to ops/histogram.py::exp_hist over that
+    domain.
+
+    The fast path accumulates per-lane partials in int32 across all
+    grid steps of one call, which silently wraps once a call's weight
+    total reaches 2^31. `widen=None` (auto) guards it: bool weights
+    can't get there below 2^38 elements; concrete integer weights are
+    summed and the widened path taken at the boundary; weights
+    arriving as tracers (a caller's jit) widen unconditionally, since
+    the total can't be inspected — pass widen=False only when the
+    caller pins its own per-call totals. The widened path splits
+    weights into 16-bit planes and super-chunks the grid
+    (hist = c_lo + (c_hi << 16), each plane's partials provably below
+    2^31), so it is exact for the full int32 weight range at any
+    input size.
+    """
+    values = jnp.asarray(values).ravel()
+    weights = jnp.asarray(weights).ravel()
+    n = values.shape[0]
+    if n == 0:
+        return jnp.zeros(N_BINS, dtype=jnp.int64)
+    if widen is None:
+        if weights.dtype == jnp.bool_:
+            # per-lane partial <= n/128 entries: safe below 2^38
+            widen = n >= _FAST_LIMIT * _LANES
+        elif not isinstance(weights, jax.core.Tracer):
+            widen = int(jnp.sum(weights, dtype=jnp.int64)) >= _FAST_LIMIT
+        else:
+            widen = True
+    if not widen:
+        c = _ladder_counts(values, weights.astype(jnp.int32), interpret)
+    else:
+        w32 = weights.astype(jnp.int32)
+        c = jnp.zeros(N_BINS, dtype=jnp.int64)
+        for s0 in range(0, n, _WIDE_CHUNK):
+            v = values[s0:s0 + _WIDE_CHUNK]
+            w = w32[s0:s0 + _WIDE_CHUNK]
+            c = c + _ladder_counts(v, w & 0xFFFF, interpret)
+            c = c + (_ladder_counts(v, (w >> 16) & 0xFFFF,
+                                    interpret) << 16)
     # hist[e] = c_e - c_{e+1}; c_63 counts x >= 2^63 (none: reuse < 2^63)
     return c - jnp.concatenate([c[1:], jnp.zeros(1, jnp.int64)])
 

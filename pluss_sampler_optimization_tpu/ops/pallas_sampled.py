@@ -1,0 +1,244 @@
+"""Pallas fused classify+histogram kernel for the sampled engine.
+
+The sampled engine's hot loop — decode the drawn mixed-radix sample
+keys, classify each sample's reuse (sampler/sampled.py::
+classify_samples), and accumulate the pow2 RI histogram — runs on the
+XLA path as a `lax.scan` whose per-step sorted unique reduction
+round-trips the (key, count) pair set through HBM on every chunk
+(`_build_ref_kernel_fused`). This kernel fuses the whole buffer into
+ONE pallas_call per ref: the classify runs inside the kernel body, and
+the noshare pow2 histogram accumulates on-chip across every grid step
+with the comparison-ladder trick proven in pallas_hist.py::pow2_hist
+(hist[e] = c_e - c_{e+1} over monotone threshold counts) — one HBM
+histogram write per ref instead of one pair-set round trip per chunk.
+
+Exactness contract (the reason no fallback path is needed):
+
+- noshare samples with ri >= 1 are ladder-binned to {2^e: count}.
+  fold_results feeds those through hist_update's pow2 binning, and
+  pow2_floor(2^e) == 2^e, so the folded PRIState is bit-identical to
+  the XLA path's raw-key stream (integer counts are exact in float64
+  and dict accumulation is order-insensitive);
+- share samples AND the rare noshare samples with ri < 1 (binning
+  applies only to keys > 0, runtime/hist.py::hist_update) ride an
+  exact residual (packed key, count) pair stream, reduced by the same
+  sorted_k_unique the XLA kernels use and decoded host-side by the
+  same decode_pairs;
+- cold (never-reused) samples count into a separate scalar.
+
+The residual stream reuses sorted_k_unique's 2^62 sentinel (a packed
+key ri*16+slot never reaches it for any representable nest), so the
+capacity-regrow contract is unchanged: n_unique > capacity makes the
+host regrow and re-dispatch, exactly like the fused XLA kernel.
+
+Selection: SamplerConfig.kernel_backend = "pallas" routes the fused
+runner here (interpret mode on the CPU backend — the configuration
+tier-1 pins; TPU lowering additionally needs Mosaic to take the
+int64 classify body and is exercised only on real hardware).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .histogram import sorted_k_unique
+
+N_BINS = 64
+_LANES = 128
+_BLOCK_ROWS = 64  # 8192 samples per grid step
+# residual-stream sentinel == sorted_k_unique's invalid sentinel;
+# NOT -1: packed = ri*16+15 with ri = -1 IS -1
+_SENTINEL = 1 << 62
+# numpy, not jnp: a module-level jnp scalar would initialize the
+# backend at import time (same rule as pallas_hist._I0)
+_I0 = np.int32(0)
+
+# (signature digest, interpret) -> jitted kernel; same bounded-LRU
+# discipline as sampled.py::_SIG_KERNELS (each closure pins a trace)
+_HIST_KERNELS: "collections.OrderedDict" = collections.OrderedDict()
+_HIST_KERNELS_MAX = 64
+
+
+def _full_spec(shape):
+    """BlockSpec covering a whole operand in every grid step."""
+    ndim = len(shape)
+    return pl.BlockSpec(shape, lambda i, _n=ndim: (_I0,) * _n)
+
+
+def _one_ref(nt, ref_idx, keys_B, mask_B, highs, vals, rx, capacity,
+             interpret):
+    """(share_keys[cap], share_counts[cap], n_unique, cold, hist[64])
+    for one ref's whole sample buffer. Traced inside the shared jit."""
+    from ..sampler.sampled import classify_samples, decode_sample_keys
+
+    block = _BLOCK_ROWS * _LANES
+    B = keys_B.shape[0]
+    pad = (-B) % block
+    if pad:
+        # decodable padding (repeats of key 0), masked out
+        keys_B = jnp.concatenate(
+            [keys_B, jnp.full(pad, keys_B[0], jnp.int64)]
+        )
+        mask_B = jnp.concatenate([mask_B, jnp.zeros(pad, bool)])
+    n_blocks = (B + pad) // block
+    kr = keys_B.reshape(n_blocks * _BLOCK_ROWS, _LANES)
+    mr = mask_B.astype(jnp.int32).reshape(n_blocks * _BLOCK_ROWS, _LANES)
+
+    leaves, treedef = jax.tree_util.tree_flatten(vals)
+    leaves = [jnp.asarray(x) for x in leaves]
+    shapes = [x.shape for x in leaves]
+    flat = [jnp.atleast_1d(x) for x in leaves]
+    n_leaves = len(flat)
+    highs = jnp.asarray(highs)
+    rx1 = jnp.asarray(rx, jnp.int64).reshape(1)
+
+    def _math(keys, highs_v, rx_v, *leaves1d):
+        """The classify, as a pure function of arrays. Traced to a
+        jaxpr OUTSIDE the pallas body so the structural array
+        constants the trace bakes in (ref tables, band plans, ...)
+        are hoisted into explicit kernel inputs — a pallas body may
+        not capture array constants (and jax.closure_convert hoists
+        only closed-over tracers, not trace-time literals)."""
+        svals = jax.tree_util.tree_unflatten(
+            treedef,
+            [leaves1d[j].reshape(shapes[j]) for j in range(n_leaves)],
+        )
+        snt = nt.with_vals(svals)
+        samples = decode_sample_keys(keys, highs_v)
+        return classify_samples(snt, ref_idx, samples, rx_v[0])
+
+    cjaxpr = jax.make_jaxpr(_math)(
+        jnp.zeros(block, jnp.int64),
+        jnp.zeros(highs.shape, highs.dtype),
+        jnp.zeros(rx1.shape, rx1.dtype),
+        *[jnp.zeros(x.shape, x.dtype) for x in flat],
+    )
+    const_shapes = [jnp.shape(c) for c in cjaxpr.consts]
+    consts = [jnp.atleast_1d(jnp.asarray(c)) for c in cjaxpr.consts]
+    n_consts = len(consts)
+
+    def body(keys_ref, mask_ref, highs_ref, rx_ref, *refs):
+        leaf_refs = refs[:n_leaves]
+        const_refs = refs[n_leaves:n_leaves + n_consts]
+        share_ref, hist_ref, misc_ref = refs[n_leaves + n_consts:]
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            hist_ref[:] = jnp.zeros_like(hist_ref)
+            misc_ref[:] = jnp.zeros_like(misc_ref)
+
+        keys = keys_ref[:].reshape(-1)
+        msk = mask_ref[:].reshape(-1) != 0
+        packed, ri, is_share, found = jax.core.eval_jaxpr(
+            cjaxpr.jaxpr,
+            [const_refs[j][:].reshape(const_shapes[j])
+             for j in range(n_consts)],
+            keys, highs_ref[:], rx_ref[:],
+            *[leaf_refs[j][:] for j in range(n_leaves)],
+        )
+        live = found & msk
+        nosh = live & (~is_share) & (ri >= 1)
+        # residual = share + sub-1 noshare: the exact pair stream
+        share_ref[:] = jnp.where(
+            live & ~nosh, packed, jnp.int64(_SENTINEL)
+        ).reshape(_BLOCK_ROWS, _LANES)
+        riw = jnp.where(nosh, ri, 0).reshape(_BLOCK_ROWS, _LANES)
+        # dtype pinned: under x64, jnp.sum(int32) promotes to int64
+        # (same rule as pallas_hist._hist_kernel)
+        rows = [
+            jnp.sum(jnp.where(riw >= (jnp.int64(1) << k),
+                              jnp.int32(1), jnp.int32(0)),
+                    axis=0, keepdims=True, dtype=jnp.int32)
+            for k in range(N_BINS - 1)
+        ]
+        # bin 63 is always empty (reuse < 2^63; 1 << 63 would wrap)
+        rows.append(jnp.zeros((1, _LANES), jnp.int32))
+        hist_ref[:] += jnp.concatenate(rows, axis=0)
+        cold = ((~found) & msk).reshape(_BLOCK_ROWS, _LANES)
+        misc_ref[0:1, :] += jnp.sum(
+            jnp.where(cold, jnp.int32(1), jnp.int32(0)),
+            axis=0, keepdims=True, dtype=jnp.int32,
+        )
+
+    share_flat, hist_part, misc = pl.pallas_call(
+        body,
+        out_shape=(
+            jax.ShapeDtypeStruct(
+                (n_blocks * _BLOCK_ROWS, _LANES), jnp.int64
+            ),
+            jax.ShapeDtypeStruct((N_BINS, _LANES), jnp.int32),
+            jax.ShapeDtypeStruct((8, _LANES), jnp.int32),
+        ),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, _I0)),
+            _full_spec(highs.shape),
+            _full_spec(rx1.shape),
+            *[_full_spec(x.shape) for x in flat],
+            *[_full_spec(c.shape) for c in consts],
+        ],
+        out_specs=(
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, _I0)),
+            pl.BlockSpec((N_BINS, _LANES), lambda i: (_I0, _I0)),
+            pl.BlockSpec((8, _LANES), lambda i: (_I0, _I0)),
+        ),
+        interpret=interpret,
+    )(kr, mr, highs, rx1, *flat, *consts)
+
+    c = jnp.sum(hist_part, axis=1, dtype=jnp.int64)
+    nosh_hist = c - jnp.concatenate([c[1:], jnp.zeros(1, jnp.int64)])
+    cold = jnp.sum(misc[0].astype(jnp.int64))
+    sflat = share_flat.reshape(-1)
+    sk, sc, nu = sorted_k_unique(sflat, sflat != _SENTINEL, capacity)
+    return sk, sc, nu, cold, nosh_hist
+
+
+def _build_hist_kernel(nt, ref_idx: int, interpret: bool):
+    from ..sampler.sampled import check_packed_ratios
+
+    check_packed_ratios(nt)
+
+    @functools.partial(
+        jax.jit, static_argnames=("capacity", "n_chunks")
+    )
+    def kernel(keys_RB, mask_RB, highs, vals, rx_R, capacity: int,
+               n_chunks: int):
+        # n_chunks kept for call-signature compatibility with the
+        # fused XLA kernel; this kernel tiles by its own block size
+        del n_chunks
+        R = keys_RB.shape[0]
+        outs = [
+            _one_ref(nt, ref_idx, keys_RB[r], mask_RB[r], highs, vals,
+                     rx_R[r], capacity, interpret)
+            for r in range(R)
+        ]
+        return tuple(
+            jnp.stack([o[j] for o in outs]) for j in range(5)
+        )
+
+    return kernel
+
+
+def hist_kernel_for(nt, ref_idx: int, digest: str, interpret: bool):
+    """Per-signature cached fused classify+histogram kernel.
+
+    Same call shape as `_build_ref_kernel_fused`'s kernel; returns
+    (share_keys[R,cap], share_counts[R,cap], max_nu[R], cold[R],
+    noshare_hist[R,64]) — the first four mirror the fused form so the
+    fused runner's drain/regrow contract applies unchanged, the fifth
+    carries the on-chip pow2 histogram."""
+    from ..sampler.sampled import lru_cached
+
+    return lru_cached(
+        _HIST_KERNELS,
+        (digest, bool(interpret)),
+        lambda: _build_hist_kernel(nt, ref_idx, interpret),
+        _HIST_KERNELS_MAX,
+    )

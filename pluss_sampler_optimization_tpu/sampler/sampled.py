@@ -1128,6 +1128,70 @@ def _use_fused(cfg) -> bool:
     return cfg.fuse_refs
 
 
+_KERNEL_BACKENDS = ("auto", "xla", "pallas", "native")
+
+
+def _resolve_kernel_backend(cfg, raw_noshare: bool = False) -> str:
+    """Resolve cfg.kernel_backend (None = "auto") to a concrete
+    backend name: "xla", "pallas", or "native".
+
+    The contract (SamplerConfig.kernel_backend): every backend folds
+    to bit-identical PRIStates/MRCs, so this is a pure speed knob and
+    stays OUT of the request fingerprint. Resolution:
+
+    - v2 raw-noshare runs force "xla": the hist backends pow2-bin
+      noshare on accumulation by construction (a warn_once fires if a
+      different backend was explicitly requested);
+    - "auto" resolves to "xla". Not to "native", deliberately: the
+      hist backends ladder-bin noshare reuse in the per-ref RESULT
+      objects (folded PRIStates/MRCs stay bit-identical, but the raw
+      SampledRefResults are a different exact representation), and
+      several standing contracts compare raw results across code
+      paths that would otherwise resolve differently (fused-vs-serial
+      in tests/test_fusion.py, batched-vs-solo in
+      tests/test_batching.py, checkpoint replay). "native" is a
+      per-call opt-in (bench kernel_roofline, --kernel-backend, the
+      service request field) where the caller consumes folded states;
+    - explicit "native" off-CPU or without the library falls back to
+      "xla" with a warn_once (never an error: the knob must stay a
+      speed knob);
+    - explicit "pallas"/"xla" are honored as-is ("pallas" runs in
+      interpret mode on CPU).
+    """
+    choice = cfg.kernel_backend if cfg.kernel_backend is not None else "auto"
+    if choice not in _KERNEL_BACKENDS:
+        raise ValueError(
+            f"kernel_backend must be one of {_KERNEL_BACKENDS}, "
+            f"got {choice!r}"
+        )
+    if raw_noshare:
+        if choice not in ("auto", "xla"):
+            telemetry.warn_once(
+                "kernel_backend_v2",
+                f"kernel_backend={choice!r} ignored: v2 raw-noshare "
+                "runs require the xla kernels (hist backends pow2-bin "
+                "noshare)",
+            )
+        return "xla"
+    on_cpu = jax.default_backend() == "cpu"
+    if choice == "auto":
+        return "xla"
+    if choice == "native":
+        from .. import native
+
+        if not on_cpu or not native.available():
+            telemetry.warn_once(
+                "kernel_backend_native",
+                "kernel_backend='native' unavailable "
+                + ("off the CPU backend" if not on_cpu
+                   else "(shared library failed to build)")
+                + "; falling back to xla",
+            )
+            return "xla"
+        return "native"
+    return choice
+
+
 def _checkpoint_tagger(program, machine, cfg, batch):
     """(idx, name) -> checkpoint tag; the program-structure hash (loops,
     refs, thresholds — same-named programs can differ structurally,
@@ -1197,6 +1261,7 @@ def sampled_outputs(
     batch: int | None = None,
     capacity: int = DEFAULT_CAPACITY,
     checkpoint_dir: str | None = None,
+    raw_noshare: bool = False,
 ):
     """Run the sampled engine; one SampledRefResult per reference.
 
@@ -1216,31 +1281,60 @@ def sampled_outputs(
     — the fused path is a pure dispatch/overlap optimization, and
     fuse_refs=False keeps the serial per-ref loop as the parity
     oracle.
+
+    cfg.kernel_backend selects the classify+histogram kernel
+    implementation (_resolve_kernel_backend): "pallas" rides the
+    fused runner with the on-chip accumulation kernel
+    (ops/pallas_sampled.py, interpret mode on CPU), "native" rides
+    the serial runner with the C++ batched classify+histogram entry
+    (native.classify_reduce), "xla" (and v2 raw-noshare runs, which
+    force it via `raw_noshare`) keeps the jit kernels. All backends
+    fold bit-identically — the knob never changes the MRC.
     """
     import os
 
     if batch is None:
         batch = default_batch()
+    backend = _resolve_kernel_backend(cfg, raw_noshare)
+    telemetry.event("kernel_backend", backend=backend)
     trace, rows = _program_kernels(program, machine)
     tag_of = None
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
         tag_of = _checkpoint_tagger(program, machine, cfg, batch)
-    if _use_fused(cfg):
+    if backend == "pallas":
+        return _sampled_outputs_fused(
+            trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of,
+            kernel_form="hist",
+        )
+    if backend != "native" and _use_fused(cfg):
         return _sampled_outputs_fused(
             trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
         )
     return _sampled_outputs_serial(
-        trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+        trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of,
+        native=backend == "native",
     )
 
 
 def _sampled_outputs_serial(
-    trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+    trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of,
+    native: bool = False,
 ):
     """The legacy per-ref loop (cfg.fuse_refs=False): one dispatch
     chain per ref, pipelined only within a ref's own host chunks. Kept
-    verbatim as the fused runner's bit-identity oracle."""
+    verbatim as the fused runner's bit-identity oracle.
+
+    `native` (kernel_backend="native", CPU only) keeps the classify in
+    XLA (the "raw" kernel form: packed keys + found mask, no on-device
+    unique reduction) and replaces the sort-based reduction with ONE
+    vectorized C++ pass per chunk (native.classify_reduce): pow2 bins
+    accumulate in a flat per-ref array, share and sub-1 noshare
+    samples collect in an exact residual hash map — on a host core the
+    XLA sort dominates the chunk wall, so this is the CPU fast path.
+    Telemetry: dispatches_native counts chunk dispatches and
+    native_chunk_plan the planned per-ref chunk counts, audited by
+    tools/check_dispatch_stats.py (dispatches_native <= plan)."""
     import os
 
     depth = max(1, cfg.pipeline_depth)
@@ -1314,7 +1408,60 @@ def _sampled_outputs_serial(
 
         ph = _pad_highs(highs)
         rxv = np.int64(ri)
-        if drawn is not None:
+        if native:
+            from .. import native as native_mod
+
+            kernel_r = ks["raw"]
+            bins = np.zeros(native_mod._NOSHARE_SLOTS, dtype=np.int64)
+            if drawn is not None:
+                n_chunks = dev_keys.shape[0] // batch
+                chunks = (
+                    (dev_keys[c * batch:(c + 1) * batch],
+                     dev_mask[c * batch:(c + 1) * batch])
+                    for c in range(n_chunks)
+                )
+            else:
+                n_chunks = -(-n_samples // batch)
+                chunks = (
+                    (_place(pad_keys(
+                        keys_all[s0:s0 + batch], 1,
+                        total=batch if n_samples > batch else None,
+                    )[0]), None)
+                    for s0 in range(0, n_samples, batch)
+                )
+                valids = [
+                    min(batch, n_samples - s0)
+                    for s0 in range(0, n_samples, batch)
+                ]
+            telemetry.count("native_chunk_plan", n_chunks)
+            for ci, (ck, cm) in enumerate(chunks):
+                telemetry.count("dispatches")
+                telemetry.count("dispatches_native")
+                with telemetry.span("dispatch", form="native"):
+                    packed, found = kernel_r(ck, ph, nt.vals, rxv)
+                with telemetry.span("fetch"):
+                    packed, found, cm = telemetry.record_fetch(
+                        jax.device_get((packed, found, cm))
+                    )
+                if cm is None:
+                    # host chunk: padding sits past the valid prefix
+                    nv = valids[ci]
+                    packed, found = packed[:nv], found[:nv]
+                with telemetry.span("merge", where="native"):
+                    pk, pc, cap, regrows = native_mod.classify_reduce(
+                        packed, found, bins, mask=cm, share_cap=cap
+                    )
+                    if regrows:
+                        telemetry.count("capacity_regrows", regrows)
+                    decode_pairs(pk, pc, noshare, share)
+            # pow2 bins -> {2^e: count}: fold_results' hist_update
+            # re-bins to pow2_floor(2^e) == 2^e, so the folded state
+            # is bit-identical to the raw-key stream's
+            for e in np.nonzero(bins[:native_mod.N_NOSHARE_BINS])[0]:
+                key = 1 << int(e)
+                noshare[key] = noshare.get(key, 0.0) + float(bins[e])
+            cold += float(bins[native_mod.N_NOSHARE_BINS])
+        elif drawn is not None:
             n_chunks = dev_keys.shape[0] // batch
 
             def redo(c2, dk=dev_keys, dm=dev_mask, nc=n_chunks, ph=ph,
@@ -1358,7 +1505,8 @@ def _sampled_outputs_serial(
 
 
 def _sampled_outputs_fused(
-    trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of
+    trace, rows, cfg, batch, capacity, checkpoint_dir, tag_of,
+    kernel_form: str = "fused",
 ):
     """Cross-ref fused, pipelined form of the sampled engine.
 
@@ -1391,6 +1539,13 @@ def _sampled_outputs_fused(
     in-flight time the host spent off the critical path) —
     tools/check_dispatch_stats.py audits `dispatches` against
     ref_buckets * expected_chunks (+ regrows).
+
+    kernel_form="hist" (kernel_backend="pallas") swaps each bucket's
+    fused XLA kernel for the Pallas on-chip classify+accumulate kernel
+    (ops/pallas_sampled.py, interpret mode on CPU). Its outputs extend
+    the fused form with a fifth per-ref pow2 noshare histogram; share
+    and sub-1 noshare samples still arrive as exact pairs, so the
+    drain/regrow contract and bit-identity both carry over unchanged.
     """
     import os
     import time
@@ -1422,10 +1577,9 @@ def _sampled_outputs_fused(
         # time this dispatch spent in flight while the host worked on
         # other buckets — the overlap the pipeline exists to buy
         overlap_s += max(0.0, time.perf_counter() - entry["t0"])
-        mk = mc = max_nu = cold = None
         dispatch_cap = entry["cap"]
         with telemetry.span("fetch", fused=True):
-            mk, mc, max_nu, cold = telemetry.record_fetch(
+            mk, mc, max_nu, cold, *rest = telemetry.record_fetch(
                 jax.device_get(entry["out"])
             )
         while int(max_nu.max()) > dispatch_cap:
@@ -1435,13 +1589,23 @@ def _sampled_outputs_fused(
             cap = max(cap, dispatch_cap)
             telemetry.count("capacity_regrows")
             with telemetry.span("fetch", fused=True, regrow=True):
-                mk, mc, max_nu, cold = telemetry.record_fetch(
+                mk, mc, max_nu, cold, *rest = telemetry.record_fetch(
                     jax.device_get(entry["redo"](dispatch_cap))
                 )
+        # the hist kernel form returns a fifth output: per-ref pow2
+        # noshare histograms accumulated on-chip
+        nh = rest[0] if rest else None
         with telemetry.span("merge"):
             for j, (idx, name, acc) in enumerate(entry["members"]):
                 acc["cold"] += float(cold[j])
                 decode_pairs(mk[j], mc[j], acc["noshare"], acc["share"])
+                if nh is not None:
+                    # {2^e: count}: hist_update's pow2_floor(2^e) is
+                    # 2^e, so the fold is bit-identical to raw keys
+                    ns = acc["noshare"]
+                    for e in np.nonzero(nh[j])[0]:
+                        key = 1 << int(e)
+                        ns[key] = ns.get(key, 0.0) + float(nh[j][e])
                 acc["left"] -= 1
                 if acc["left"] == 0:
                     finalize(idx, name, acc)
@@ -1539,7 +1703,15 @@ def _sampled_outputs_fused(
                     (idx, ri, sk, chosen)
                 )
         ph = _pad_highs(highs)
-        fused = rows[members[0][0]][2]["fused"]
+        if kernel_form == "hist":
+            from ..ops.pallas_sampled import hist_kernel_for
+
+            fused = hist_kernel_for(
+                nt, members[0][1], sig,
+                interpret=jax.default_backend() == "cpu",
+            )
+        else:
+            fused = rows[members[0][0]][2][kernel_form]
         bucket_dispatches = 0
         for B, grp in dev_groups.items():
             rx_R = jnp.asarray([ri for _, ri, _, _ in grp], jnp.int64)
@@ -1691,7 +1863,11 @@ def run_sampled(
     cfg = cfg or SamplerConfig()
     _apply_compilation_cache(cfg)
     with telemetry.span("engine", engine="sampled"):
-        results = sampled_outputs(program, machine, cfg, **kw)
+        # v2 keeps raw noshare keys: force the xla kernels (the hist
+        # backends pow2-bin noshare on accumulation)
+        results = sampled_outputs(
+            program, machine, cfg, raw_noshare=v2, **kw
+        )
         with telemetry.span("merge", stage="fold_results"):
             state = fold_results(results, machine.thread_num, v2)
     return state, results

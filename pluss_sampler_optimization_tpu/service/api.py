@@ -115,6 +115,10 @@ class AnalysisRequest:
     # settings.
     fuse_refs: bool | None = None
     pipeline_depth: int | None = None
+    # kernel_backend rides with them: all backends fold bit-identical
+    # PRIStates (pinned by tests/test_pallas.py), so it too must stay
+    # out of the fingerprint
+    kernel_backend: str | None = None
     # Inline frontend document (frontend/schema.py) — the
     # "MRC-as-a-service" path. Mutually exclusive with addressing a
     # registry model: when set, `model` is the CUSTOM_MODEL sentinel
@@ -144,6 +148,13 @@ class AnalysisRequest:
             )
         if self.runtime not in ("v1", "v2"):
             raise ValueError("runtime must be 'v1' or 'v2'")
+        if self.kernel_backend not in (
+            None, "auto", "xla", "pallas", "native"
+        ):
+            raise ValueError(
+                f"unknown kernel_backend {self.kernel_backend!r} "
+                "(have auto, xla, pallas, native)"
+            )
         if self.program is not None:
             if not isinstance(self.program, dict):
                 raise ValueError("'program' must be a JSON object")

@@ -191,6 +191,8 @@ def sampler_config(request) -> SamplerConfig:
         kw["fuse_refs"] = request.fuse_refs
     if request.pipeline_depth is not None:
         kw["pipeline_depth"] = request.pipeline_depth
+    if getattr(request, "kernel_backend", None) is not None:
+        kw["kernel_backend"] = request.kernel_backend
     return SamplerConfig(ratio=request.ratio, seed=request.seed, **kw)
 
 

@@ -234,6 +234,9 @@ def test_bench_emits_json_line(tmp_path):
         assert last["metric"].startswith("gemm64_")
         assert last["value"] > 0
         assert len(last["mrc_digest"]) == 16
+        # every row self-identifies whether it came from a
+        # probe-fallback (CPU) run — silent fallback is the hazard
+        assert isinstance(last["device_fallback"], bool)
     finally:
         for name in created:
             if name.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY")):
@@ -277,6 +280,26 @@ def test_bench_emits_json_line(tmp_path):
     # n=64 are too noisy to gate a test on)
     fr = doc["extra"]["flight_recorder"]
     assert "error" not in fr, fr
+    # fused-kernel roofline evidence: both CPU backends measured with
+    # per-stage spans, the native hot loop compared against the
+    # fused-XLA baseline, MRC digests identical across backends, and
+    # the three-way (xla/pallas/native) parity pin on the bounded
+    # mini program all-identical
+    kr = doc["extra"]["kernel_roofline"]
+    assert "error" not in kr, kr
+    for b in ("xla", "native"):
+        row = kr["backends"][b]
+        assert "error" not in row, row
+        assert row["wall_s"] > 0
+        assert set(row["stage_s"]) == {"draw", "dispatch", "fetch",
+                                       "merge"}
+        assert row["samples"] > 0
+        assert len(row["mrc_digest"]) == 16
+    assert kr["backends"]["native"]["hot_loop_speedup_vs_xla"] > 0
+    assert kr["digests_identical"] is True
+    dp = kr["digest_parity"]
+    assert set(dp["digests"]) == {"xla", "pallas", "native"}
+    assert dp["identical"] is True
     ro = fr["recorder_overhead"]
     assert ro["disabled_s"] > 0 and ro["enabled_s"] > 0
     assert ro["budget_pct"] == 2.0
@@ -303,3 +326,26 @@ def test_bench_emits_json_line(tmp_path):
     if "device_fallback" in doc["extra"]:
         assert cc["dir"].endswith(host["cpu_features_hash"])
         assert cc["total"]["compile_requests"] > 0
+
+
+def test_bench_require_accelerator_refuses_cpu():
+    """--require-accelerator turns the silent CPU fallback into a
+    refusal: on this accelerator-less host the probe fails and bench
+    must exit 2 BEFORE benchmarking (no evidence/telemetry sidecars,
+    no ledger row — a refused run leaves nothing to misfile)."""
+    before = set(os.listdir(REPO))
+    with _marker_absent():
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--n", "16", "--device-timeout", "1",
+             "--require-accelerator"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+        )
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
+    assert "--require-accelerator" in proc.stderr
+    created = set(os.listdir(REPO)) - before
+    assert not any(
+        n.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY"))
+        or n == "LEDGER.jsonl"
+        for n in created
+    ), created

@@ -359,12 +359,22 @@ def test_batched_members_share_execution_span(tmp_path):
         for m, n, s in (("gemm", 24, 5), ("gemm", 32, 7),
                         ("2mm", 12, 11))
     ]
+    # Deterministic batch formation: a wall-clock window alone is
+    # flaky on a loaded host (the scheduler can flush before the
+    # third submit lands). Set max_refs to the EXACT tracked-ref
+    # total of the three programs, so the early-flush fires on the
+    # third enqueue and the (long) window is pure fallback.
+    total_refs = sum(
+        sum(len(nest.refs) for nest in REGISTRY[r.model](r.n).nests)
+        for r in reqs
+    )
     ledger_path = str(tmp_path / "ledger.jsonl")
     reg = obs_metrics.enable()
     tele = telemetry.enable()
     with AnalysisService(cache_dir=str(tmp_path / "store"),
                          ledger_path=ledger_path,
-                         batch_window_ms=400.0) as svc:
+                         batch_window_ms=30000.0,
+                         batch_max_refs=total_refs) as svc:
         tickets = [svc.submit(r) for r in reqs]
         resps = [svc.result(t, timeout=300) for t in tickets]
     telemetry.disable()

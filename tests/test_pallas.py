@@ -1,12 +1,34 @@
 """Pallas pow2-histogram kernel vs the portable exp_hist (interpret
-mode on CPU; the same kernel compiles for TPU via pow2_hist_auto)."""
+mode on CPU; the same kernel compiles for TPU via pow2_hist_auto),
+the per-call weight-total overflow guard, and engine-level parity of
+the fused draw+classify+histogram backends (pallas interpret / native
+vs the xla oracle)."""
+
+import dataclasses as dc
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from pluss_sampler_optimization_tpu import native
+from pluss_sampler_optimization_tpu.config import (
+    MachineConfig,
+    SamplerConfig,
+)
+from pluss_sampler_optimization_tpu.frontend.fuzz import (
+    _fold_mrc,
+    _states_equal,
+)
+from pluss_sampler_optimization_tpu.ir import (
+    Loop,
+    ParallelNest,
+    Program,
+    Ref,
+)
+from pluss_sampler_optimization_tpu.models import REGISTRY
 from pluss_sampler_optimization_tpu.ops.histogram import exp_hist
 from pluss_sampler_optimization_tpu.ops.pallas_hist import pow2_hist
+from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
 
 
 @pytest.mark.parametrize("n", [1, 100, 1024, 5000])
@@ -51,3 +73,95 @@ def test_pallas_hist_all_masked():
         jnp.asarray(vals), jnp.zeros(300, dtype=jnp.int64), interpret=True
     )
     assert int(np.asarray(got).sum()) == 0
+
+
+def test_pow2_hist_weight_total_overflow_boundary():
+    """Regression: a per-call weight total of exactly 2^31 must take
+    the widened path and stay exact. Two heavy entries land in the
+    SAME lane (elements 0 and 128 of the (rows, 128) layout), so the
+    fast path's int32 per-lane partial would wrap to negative — the
+    forced-fast run below documents exactly the hazard the auto guard
+    exists for."""
+    n = 1024  # one full (8, 128) block
+    vals = np.full(n, 1 << 10, dtype=np.int64)
+    w = np.zeros(n, dtype=np.int64)
+    w[0] = 1 << 30
+    w[128] = 1 << 30  # same lane as element 0
+    expect = np.zeros(64, dtype=np.int64)
+    expect[10] = 1 << 31
+
+    got = pow2_hist(jnp.asarray(vals), jnp.asarray(w), interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+    wrapped = pow2_hist(jnp.asarray(vals), jnp.asarray(w),
+                        interpret=True, widen=False)
+    assert int(np.asarray(wrapped)[10]) < 0  # int32 partial wrapped
+
+    # one below the boundary the fast path is still exact (and is
+    # what auto picks), pinning the guard's threshold from both sides
+    w[128] -= 1
+    expect[10] -= 1
+    near = pow2_hist(jnp.asarray(vals), jnp.asarray(w), interpret=True)
+    np.testing.assert_array_equal(np.asarray(near), expect)
+    fast = pow2_hist(jnp.asarray(vals), jnp.asarray(w),
+                     interpret=True, widen=False)
+    np.testing.assert_array_equal(np.asarray(fast), expect)
+
+
+def test_pow2_hist_widen_explicit_matches_exp_hist():
+    """The widened path (16-bit weight planes + super-chunked grid)
+    is exact over ordinary inputs too, not just at the boundary."""
+    rng = np.random.default_rng(19)
+    vals = rng.integers(1, 1 << 40, size=700)
+    w = rng.integers(0, 1 << 20, size=700)
+    ref = exp_hist(jnp.asarray(vals), jnp.asarray(w))
+    got = pow2_hist(jnp.asarray(vals), jnp.asarray(w),
+                    interpret=True, widen=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+# --- engine-level kernel_backend parity ------------------------------
+#
+# The fused pallas draw+classify+histogram kernel compiles one
+# pallas_call per ref; in interpret mode XLA compiles the resulting
+# HLO from scratch, which costs tens of seconds per ref on CPU. The
+# tier-1 parity pin therefore runs the smallest program that still
+# exercises both kernel forms (a noshare-only ref and a share ref):
+# larger models ride tools/fuzz_ir.py --kernel-backend and the slow
+# marker, not tier-1.
+
+_MINI = Program(
+    name="parity-mini",
+    nests=(ParallelNest(
+        loops=(Loop(8), Loop(8)),
+        refs=(Ref("A0", "A", level=1, coeffs=(8, 1)),
+              Ref("B0", "B", level=1, coeffs=(0, 1),
+                  share_threshold=9)),
+    ),),
+)
+
+
+def _assert_backend_parity(program, backend):
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=3)
+    state_x, _ = run_sampled(
+        program, machine, dc.replace(cfg, kernel_backend="xla"))
+    state_b, _ = run_sampled(
+        program, machine, dc.replace(cfg, kernel_backend=backend))
+    assert _states_equal(state_b, state_x, machine.thread_num)
+    assert (_fold_mrc(state_b, machine).tobytes()
+            == _fold_mrc(state_x, machine).tobytes())
+
+
+def test_engine_pallas_parity_interpret():
+    """run_sampled(kernel_backend="pallas") folds bit-identical to the
+    xla oracle (interpret mode on this CPU host)."""
+    _assert_backend_parity(_MINI, "pallas")
+
+
+def test_engine_native_parity():
+    """run_sampled(kernel_backend="native") folds bit-identical to the
+    xla oracle on a real (small) model."""
+    if not native.available():
+        pytest.skip("native runtime unavailable on this host")
+    _assert_backend_parity(REGISTRY["gemm"](16), "native")

@@ -189,6 +189,49 @@ def test_check_dispatch_stats_batched(tmp_path):
     assert check_dispatch_stats.main([bad]) == 1
 
 
+def test_check_dispatch_stats_native(tmp_path):
+    """A kernel_backend="native" run exports its chunk plan as the
+    native_chunk_plan counter and stamps every native dispatch; the
+    checker enforces dispatches_native <= native_chunk_plan (a hard
+    ceiling — native regrows are host-side C re-calls, never
+    re-dispatches) and flags a plan-less or over-plan document."""
+    from pluss_sampler_optimization_tpu import SamplerConfig, native
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        run_sampled,
+    )
+
+    if not native.available():
+        pytest.skip("native runtime unavailable on this host")
+    tele = telemetry.enable()
+    run_sampled(REGISTRY["gemm"](16), MACHINE,
+                SamplerConfig(ratio=0.25, seed=3,
+                              kernel_backend="native"))
+    telemetry.disable()
+    path = str(tmp_path / "native.json")
+    tele.write_json(path)
+    assert check_dispatch_stats.main([path]) == 0
+
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["counters"]["dispatches_native"] > 0
+    error, note = check_dispatch_stats.check(doc)
+    assert error is None and "native" in note
+    # a regression: the native path re-dispatching past its plan
+    doc["counters"]["dispatches_native"] = (
+        doc["counters"]["native_chunk_plan"] + 1
+    )
+    bad = str(tmp_path / "native_regressed.json")
+    with open(bad, "w") as f:
+        json.dump(doc, f)
+    assert check_dispatch_stats.main([bad]) == 1
+    # ... and native dispatches without any exported plan
+    del doc["counters"]["native_chunk_plan"]
+    planless = str(tmp_path / "native_planless.json")
+    with open(planless, "w") as f:
+        json.dump(doc, f)
+    assert check_dispatch_stats.main([planless]) == 1
+
+
 def test_json_schema_roundtrip(tmp_path):
     tele = telemetry.enable()
     with telemetry.span("stage"):

@@ -14,8 +14,15 @@ the contract's enforcement point:
     dispatches <= ref_buckets * expected_chunks + capacity_regrows
 
 (each capacity regrow legitimately re-runs one bucket dispatch).
-Exercised from the test suite (tests/test_telemetry.py) like the other
-check_* tools, so tier-1 catches regressions.
+Runs with kernel_backend="native" export their own plan, checked the
+same way:
+
+    dispatches_native <= native_chunk_plan
+
+(native regrows are host-side C re-calls, never re-dispatches, so the
+plan is a hard ceiling). Exercised from the test suite
+(tests/test_telemetry.py) like the other check_* tools, so tier-1
+catches regressions.
 
     python tools/check_dispatch_stats.py TELEMETRY.json [more.json ...]
 
@@ -46,10 +53,42 @@ def check(doc) -> tuple[str | None, str | None]:
     gauges = doc.get("gauges")
     if not isinstance(counters, dict) or not isinstance(gauges, dict):
         return "missing counters/gauges objects", None
+    # native fast-path accounting rides the same sidecar: the serial
+    # runner exports its chunk plan as the `native_chunk_plan` counter
+    # (a counter, not a gauge, so multi-rep bench accumulation keeps
+    # the bound meaningful) and stamps every native dispatch into
+    # `dispatches_native`. One native chunk is exactly one raw-kernel
+    # dispatch, and capacity regrows happen host-side (a C re-call,
+    # never a re-dispatch), so the plan is a hard ceiling.
+    native = counters.get("dispatches_native")
+    native_note = None
+    if native is not None:
+        plan = counters.get("native_chunk_plan")
+        if plan is None:
+            return (
+                f"dispatches_native {native:g} recorded without a "
+                "native_chunk_plan counter — native accounting "
+                "regressed",
+                None,
+            )
+        if native > plan:
+            return (
+                f"dispatches_native {native:g} exceed the chunk "
+                f"plan {plan:g} — native fast path re-dispatched",
+                None,
+            )
+        native_note = f"native {native:g} <= plan {plan:g}"
     union = gauges.get("ref_buckets_union")
     buckets = union if union is not None else gauges.get("ref_buckets")
     chunks = gauges.get("expected_chunks")
     if buckets is None or chunks is None:
+        if native_note:
+            # the native path is serial by construction, so lacking
+            # the fusion gauges is its normal shape
+            return None, (
+                f"{native_note}; no fusion gauges (native/unfused "
+                "run) — fusion bound skipped"
+            )
         return None, "no fusion gauges (unfused run?) — skipped"
     # batched (cross-request) runs export ref_buckets_union: the bound
     # is over the UNION bucket plan, the whole point of merging —
@@ -66,11 +105,14 @@ def check(doc) -> tuple[str | None, str | None]:
             f"{regrows:g}) — cross-ref fusion regressed",
             None,
         )
-    return None, (
+    note = (
         f"dispatches {dispatches:g} <= {bound:g} "
         f"({buckets:g} {kind} * {chunks:g} chunks + {regrows:g} "
         "regrows)"
     )
+    if native_note:
+        note += f"; {native_note}"
+    return None, note
 
 
 def main(argv=None) -> int:

@@ -7,7 +7,7 @@ and rejection-with-diagnostic for every invalid mutant.
 
     python tools/fuzz_ir.py [--seeds N] [--start-seed S]
         [--ratio R] [--drift-max D] [--mutants M]
-        [--batched] [--sharded] [--json] [-v]
+        [--batched] [--sharded] [--kernel-backend B ...] [--json] [-v]
 
 `--batched` additionally pushes every seed through the batched
 engine (sampler/sampled.py::run_sampled_multi, the BatchScheduler's
@@ -15,6 +15,11 @@ union-bucket path) in a mixed 3-job bucket and requires bit-identity
 to the solo run; `--sharded` does the same through
 parallel/sharded.py::run_sampled_sharded on a 2-device virtual CPU
 mesh (pinned via _platform.force_virtual_cpu before jax comes up).
+`--kernel-backend` (repeatable: xla, pallas, native) re-runs each
+seed's solo config per named classify+histogram backend
+(SamplerConfig.kernel_backend — pallas is interpret mode on CPU)
+and requires bit-identity to the solo run, which is itself
+drift-bounded against the numpy oracle.
 
 Exit code: nonzero on ANY oracle mismatch, drift violation, accepted
 mutant, batched/sharded divergence, or parser crash — so the sweep
@@ -61,6 +66,12 @@ def main(argv=None) -> int:
     ap.add_argument("--sharded", action="store_true",
                     help="also check run_sampled_sharded bit-identity "
                          "vs solo per seed (2-device virtual mesh)")
+    ap.add_argument("--kernel-backend", action="append", default=[],
+                    choices=["xla", "pallas", "native"],
+                    metavar="B", dest="kernel_backends",
+                    help="also re-run each seed with this "
+                         "SamplerConfig.kernel_backend and check "
+                         "bit-identity vs solo (repeatable)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -88,6 +99,7 @@ def main(argv=None) -> int:
         args.seeds, start=args.start_seed, ratio=args.ratio,
         drift_max=args.drift_max, n_mutants=args.mutants,
         batched=args.batched, sharded=args.sharded,
+        kernel_backends=tuple(args.kernel_backends),
         progress=progress,
     )
     summary["wall_s"] = round(time.time() - t0, 1)

@@ -68,6 +68,10 @@ TARGETS = (
     (f"{PKG}/runtime/faults.py", "_mix"),
     (f"{PKG}/runtime/faults.py", "counter_u01"),
     (f"{PKG}/runtime/faults.py", "backoff_delay"),
+    # kernel-backend selection must depend only on (config, backend
+    # platform, library availability) — a clock or RNG here would
+    # make bit-identity across kernel_backend values unreproducible
+    (f"{PKG}/sampler/sampled.py", "_resolve_kernel_backend"),
 )
 
 ALLOWLIST_PATH = os.path.join(
