@@ -224,6 +224,7 @@ def guarded_backend_init(
 # main() so the probe/watchdog path stays import-light.
 
 EVIDENCE_SIDECAR = "BENCH_EVIDENCE.json"  # `latest` pointer, kept stable
+BENCH_OUT_DIR = "bench_out"  # stamped evidence/telemetry files land here
 HEADLINE_MAX_BYTES = 500
 
 _RUN_SEQ = [0]  # process-local tiebreak: same-second same-pid calls
@@ -275,7 +276,12 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
 
     sidecar_dir = sidecar_dir or os.path.dirname(os.path.abspath(__file__))
     stamped = _stamped_sidecar_name(str(headline.get("metric", "run")))
-    evidence_ref = stamped
+    # stamped files accumulate one per run, so they live under
+    # bench_out/ (gitignored) instead of littering the repo root; the
+    # fixed-name `latest` pointer stays at sidecar_dir and the headline
+    # `evidence` ref carries the bench_out/ prefix so readers resolve
+    # it relative to the pointer's directory
+    evidence_ref = os.path.join(BENCH_OUT_DIR, stamped)
     try:
         # atomic (tmp+rename): a killed bench never leaves a truncated
         # evidence file for the driver's collectors to choke on
@@ -283,7 +289,9 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
             atomic_write_json,
         )
 
-        atomic_write_json(os.path.join(sidecar_dir, stamped), full)
+        os.makedirs(os.path.join(sidecar_dir, BENCH_OUT_DIR),
+                    exist_ok=True)
+        atomic_write_json(os.path.join(sidecar_dir, evidence_ref), full)
     except OSError:
         evidence_ref = "stdout line above (sidecar write failed)"
     else:
@@ -295,10 +303,10 @@ def emit_result(headline: dict, extra: dict, sidecar_dir: str | None = None,
         try:
             if os.path.islink(latest) or os.path.exists(latest):
                 os.remove(latest)
-            os.symlink(stamped, latest)
+            os.symlink(evidence_ref, latest)
         except OSError:
             try:
-                atomic_write_json(latest, {"latest": stamped},
+                atomic_write_json(latest, {"latest": evidence_ref},
                                   indent=None)
             except OSError:
                 pass
@@ -1731,6 +1739,62 @@ def main() -> int:
         except Exception as e:  # never sink the headline metric
             fr["error"] = repr(e)
 
+    # Where the wall time goes: the headline plateau is diagnosable
+    # only if the evidence says which stage ate the wall. Re-run the
+    # hot engine path under the sampling wall-clock profiler
+    # (runtime/obs/profiler.py) and attribute every sample to its
+    # telemetry span path: per-stage fractions (executing / sync /
+    # queue / unattributed, summing to ~1.0 by construction) plus the
+    # top-k attributed stacks, so a reader can tell interpreter
+    # overhead from device-sync stalls without reproducing the run.
+    if extras_budget_left("where_time_goes", extra):
+        wt: dict = {}
+        extra["where_time_goes"] = wt
+        try:
+            from pluss_sampler_optimization_tpu.runtime.obs import (
+                attribution as obs_attribution,
+                profiler as obs_profiler,
+            )
+
+            timed_engine_run()  # re-warm after the preceding extras
+            prof = obs_profiler.enable(hz=250.0)
+            try:
+                t0 = time.perf_counter()
+                reps_done = 0
+                # enough reps for a statistically useful sample count
+                # on fast configs, bounded so slow ones stay cheap
+                while reps_done < 3 or (
+                    time.perf_counter() - t0 < 0.5 and reps_done < 50
+                ):
+                    with telemetry.span("rep", engine=args.engine):
+                        timed_engine_run()
+                    reps_done += 1
+            finally:
+                obs_profiler.disable()
+            snap = prof.snapshot()
+            br = obs_attribution.sample_breakdown(snap)
+            wt.update({
+                "engine": args.engine,
+                "hz": snap["hz"],
+                "reps": reps_done,
+                "samples": snap["samples"],
+                "attribution_completeness":
+                    snap["attribution_completeness"],
+                "breakdown": br,
+                "top_stacks": [
+                    {
+                        "span": s["span"],
+                        "count": s["count"],
+                        "seconds": s["seconds"],
+                        "leaf": s["frames"][-1] if s["frames"]
+                        else None,
+                    }
+                    for s in snap["stacks"][:10]
+                ],
+            })
+        except Exception as e:  # never sink the headline metric
+            wt["error"] = repr(e)
+
     # Lockdep-witness overhead on the serving path: the witness wraps
     # every service lock when armed, so "pure observer" is a
     # measurable claim — served wall witness-on vs off under the same
@@ -1876,12 +1940,13 @@ def main() -> int:
     # files; the evidence JSON names it so the two cross-reference
     telemetry.disable()
     tele_name = _stamped_sidecar_name(metric, prefix="BENCH_TELEMETRY")
+    tele_ref = os.path.join(BENCH_OUT_DIR, tele_name)
     try:
-        tele.write_json(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         tele_name)
-        )
-        extra["telemetry"] = tele_name
+        script_dir = os.path.dirname(os.path.abspath(__file__))
+        os.makedirs(os.path.join(script_dir, BENCH_OUT_DIR),
+                    exist_ok=True)
+        tele.write_json(os.path.join(script_dir, tele_ref))
+        extra["telemetry"] = tele_ref
     except OSError:
         extra["telemetry"] = "unwritable"
     tele.print_summary()

@@ -630,6 +630,30 @@ def main(argv=None) -> int:
         "scrape endpoint. See README \"Live metrics & SLOs\".",
     )
     ap.add_argument(
+        "--profile-hz",
+        type=float,
+        default=None,
+        metavar="HZ",
+        help="serve mode: run the sampling wall-clock profiler — a "
+        "background thread samples every live thread's Python stack "
+        "HZ times a second, tags each sample with the thread's "
+        "current telemetry span path (draw/dispatch/fetch/merge/"
+        "queue/... or 'unattributed'), and folds them into bounded "
+        "collapsed-stack counts. Scrape the live snapshot at "
+        "GET /debug/profile (with --metrics-port); anomaly "
+        "post-mortem bundles carry it too. Default: off. See README "
+        "\"Continuous profiling & utilization\".",
+    )
+    ap.add_argument(
+        "--profile-out",
+        default=None,
+        metavar="PATH",
+        help="with --profile-hz: at serve exit, write the collected "
+        "profile as speedscope-compatible JSON to PATH (drop it on "
+        "https://www.speedscope.app) and the collapsed-stack text "
+        "to PATH + '.collapsed'",
+    )
+    ap.add_argument(
         "--slo-latency-p95-s",
         type=float,
         default=None,
@@ -760,6 +784,13 @@ def main(argv=None) -> int:
                 "--metrics-port exposes the live serving registry; "
                 "it applies to serve mode only"
             )
+        if args.profile_hz is not None or args.profile_out is not None:
+            raise SystemExit(
+                "--profile-hz/--profile-out run the serving "
+                "sampling profiler; they apply to serve mode only "
+                "(offline stage profiles come from "
+                "tools/profile_tpu_stages.py)"
+            )
         if (args.slo_latency_p95_s is not None
                 or args.slo_error_budget is not None):
             raise SystemExit(
@@ -795,6 +826,13 @@ def main(argv=None) -> int:
             "needs --ledger PATH"
         )
 
+    if args.profile_hz is not None and args.profile_hz <= 0:
+        raise SystemExit("--profile-hz must be > 0 (samples per "
+                         "second; omit the flag to keep the profiler "
+                         "off)")
+    if args.profile_out is not None and args.profile_hz is None:
+        raise SystemExit("--profile-out exports the collected "
+                         "profile; it needs --profile-hz")
     if args.replicas is not None and args.replicas < 0:
         raise SystemExit("--replicas must be >= 0 (0 = auto, one "
                          "replica per device)")
@@ -1111,6 +1149,7 @@ def _serve(args) -> int:
     from .runtime import faults
     from .runtime.obs import ledger as obs_ledger
     from .runtime.obs import metrics as obs_metrics
+    from .runtime.obs import profiler as obs_profiler
     from .runtime.obs import recorder as obs_recorder
     from .service import AnalysisService, GracefulShutdown, serve_jsonl
 
@@ -1120,6 +1159,14 @@ def _serve(args) -> int:
         else open(args.responses, "w")
     )
     registry = obs_metrics.enable()
+    profiler = None
+    if args.profile_hz is not None:
+        profiler = obs_profiler.enable(hz=args.profile_hz)
+        print(
+            f"serve: sampling profiler on at {args.profile_hz:g} Hz "
+            "(snapshot at GET /debug/profile)",
+            file=sys.stderr,
+        )
     server = None
     sentinel = None
     recorder = None
@@ -1154,6 +1201,7 @@ def _serve(args) -> int:
                     "fault_spec", "attempt_timeout_s", "max_retries",
                     "hedge_after_s", "queue_limit", "no_shed",
                     "breaker_failures", "breaker_probation_s",
+                    "profile_hz", "profile_out",
                 )
             },
         )
@@ -1224,6 +1272,10 @@ def _serve(args) -> int:
                             "bundles": recorder.bundle_index(),
                         }) if recorder is not None else None
                     ),
+                    # always wired: the route answers a structured
+                    # 404 JSON body when the profiler is off, so
+                    # pollers never see a bare HTML error page
+                    profile=obs_profiler.snapshot,
                 )
                 print(
                     f"serve: live metrics on "
@@ -1333,6 +1385,25 @@ def _serve(args) -> int:
                     signal.signal(signal.SIGUSR2, prev_usr2)
                 except ValueError:
                     pass
+        if profiler is not None:
+            obs_profiler.disable()
+            if args.profile_out:
+                try:
+                    profiler.write_speedscope(args.profile_out)
+                    profiler.write_collapsed(
+                        args.profile_out + ".collapsed"
+                    )
+                    snap = profiler.snapshot()
+                    print(
+                        "serve: profile written to "
+                        f"{args.profile_out} ({snap['samples']} "
+                        "samples, attribution completeness "
+                        f"{snap['attribution_completeness']})",
+                        file=sys.stderr,
+                    )
+                except Exception as e:
+                    print(f"serve: profile export failed: {e!r}",
+                          file=sys.stderr)
         obs_metrics.disable()
         if fin is not sys.stdin:
             fin.close()

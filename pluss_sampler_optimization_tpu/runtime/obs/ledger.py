@@ -189,6 +189,13 @@ def validate_row(row) -> list[str]:
                 errors.append(f"'{flag}' must be a boolean")
         if "retries" in row:
             need_num("retries", nullable=True)
+        # per-request utilization attribution block
+        # (runtime/obs/attribution.py): optional — rows written
+        # without the attribution layer keep their exact shape
+        if "utilization" in row and row["utilization"] is not None:
+            from .attribution import validate_block
+
+            errors.extend(validate_block(row["utilization"]))
     elif kind == "drift":
         need_str("model")
         need_num("n")
@@ -477,9 +484,18 @@ def aggregate(rows: list[dict]) -> dict:
             eng = row["engine_requested"]
             agg = requests.setdefault(eng, {
                 "count": 0, "ok": 0, "failed": 0, "degraded": 0,
-                "latencies": [],
+                "latencies": [], "busy_fractions": [],
+                "unattributed_fractions": [],
                 "cache": {"mem": 0, "disk": 0, "miss": 0, "direct": 0},
             })
+            util = row.get("utilization")
+            if isinstance(util, dict):
+                bf = util.get("busy_fraction")
+                uf = util.get("unattributed_fraction")
+                if isinstance(bf, (int, float)):
+                    agg["busy_fractions"].append(float(bf))
+                if isinstance(uf, (int, float)):
+                    agg["unattributed_fractions"].append(float(uf))
             agg["count"] += 1
             if row["ok"]:
                 agg["ok"] += 1
@@ -504,6 +520,18 @@ def aggregate(rows: list[dict]) -> dict:
         served = warm + agg["cache"]["miss"]
         agg["cache_hit_rate"] = (
             round(warm / served, 3) if served else None
+        )
+        # utilization attribution rollup: mean busy + tail
+        # unattributed per engine (rows without a block contribute
+        # nothing — both stay None when no row carried one)
+        busy = agg.pop("busy_fractions")
+        unatt = sorted(agg.pop("unattributed_fractions"))
+        agg["utilization_rows"] = len(busy)
+        agg["mean_busy_fraction"] = (
+            round(sum(busy) / len(busy), 4) if busy else None
+        )
+        agg["p95_unattributed_fraction"] = (
+            round(_percentile(unatt, 0.95), 4) if unatt else None
         )
     occupancy = sorted(
         max(b["rows"], b["members"]) for b in batches.values()
@@ -564,6 +592,16 @@ def format_stats(agg: dict) -> list[str]:
                 f"{c['disk']:>4} {c['miss']:>4} {c['direct']:>4} "
                 f"{hit:>5} {a['degraded']:>4}"
             )
+    util_parts = [
+        "%s busy=%.2f p95_unattr=%.2f (%d rows)" % (
+            eng, a["mean_busy_fraction"],
+            a["p95_unattributed_fraction"], a["utilization_rows"],
+        )
+        for eng, a in sorted(agg["requests"].items())
+        if a.get("mean_busy_fraction") is not None
+    ]
+    if util_parts:
+        lines.append("utilization: " + ", ".join(util_parts))
     for row in agg["drift"]:
         lines.append(
             "drift %s n=%d: max_abs=%.4f mean_abs=%.5f %s" % (

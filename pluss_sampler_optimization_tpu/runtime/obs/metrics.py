@@ -470,32 +470,49 @@ class MetricsServer:
     control requests answer with, so liveness probes and dashboards
     don't need to speak the serving protocol. `/healthz` stays
     answerable even without a service callable (plain liveness of the
-    scrape server itself). `port=0` binds an ephemeral port (read it
-    back from `.port`). Serves 404 elsewhere and never raises into
-    the serving thread."""
+    scrape server itself). The `profile` callable (the serve CLI
+    passes `profiler.snapshot`) backs `GET /debug/profile`: the live
+    sampling-profiler snapshot when the profiler is running, and a
+    structured 404 JSON body (not a bare HTML error page) when it is
+    off, so pollers always get machine-readable state. `port=0` binds
+    an ephemeral port (read it back from `.port`). Serves 404
+    elsewhere and never raises into the serving thread."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  host: str = "127.0.0.1", prefix: str = "pluss_",
-                 healthz=None, stats=None, bundles=None):
+                 healthz=None, stats=None, bundles=None,
+                 profile=None):
         import http.server
 
         reg = registry
 
         def _json_route(path: str):
-            """The JSON payload for `path`, or None for no route."""
+            """(status, payload) for `path`, or None for no route."""
             if path == "/healthz":
-                return healthz() if healthz is not None else {
+                return 200, (healthz() if healthz is not None else {
                     "status": "ok", "service": False,
-                }
+                })
             if path == "/stats" and stats is not None:
-                return stats()
+                return 200, stats()
             if path == "/debug/bundles" and bundles is not None:
-                return bundles()
+                return 200, bundles()
+            if path == "/debug/profile" and profile is not None:
+                snap = profile()
+                if snap is None:
+                    return 404, {
+                        "error": "profiler not running",
+                        "status": 404,
+                        "hint": "start serve mode with "
+                                "--profile-hz HZ to enable the "
+                                "sampling profiler",
+                    }
+                return 200, snap
             return None
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path in ("/metrics", "/"):
                     try:
                         body = reg.prometheus_text(
@@ -508,16 +525,17 @@ class MetricsServer:
                         return
                 else:
                     try:
-                        payload = _json_route(path)
+                        routed = _json_route(path)
                     except Exception as e:  # pragma: no cover
                         self.send_error(500, repr(e))
                         return
-                    if payload is None:
+                    if routed is None:
                         self.send_error(404)
                         return
+                    status, payload = routed
                     body = (json.dumps(payload) + "\n").encode()
                     ctype = "application/json"
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -194,7 +194,31 @@ def validate_bundle(doc) -> list[str]:
     p = doc.get("profile")
     if p is not None and not isinstance(p, str):
         errors.append("'profile' must be a string path or null")
+    # the live sampling profiler's snapshot (runtime/obs/profiler.py)
+    # rides bundles when --profile-hz is on — distinct from `profile`
+    # (the jax device-memory capture's file path)
+    ps = doc.get("profile_snapshot")
+    if ps is not None and not isinstance(ps, dict):
+        errors.append("'profile_snapshot' must be an object or null")
     return errors
+
+
+def _profiler_snapshot(top: int = 50):
+    """The live sampling profiler's snapshot with the stack list
+    trimmed to the heaviest `top` entries (a bundle is point-in-time
+    evidence, not a full export — /debug/profile serves the whole
+    thing); None when the profiler is off. Never raises: a profiler
+    problem must not sink a post-mortem dump."""
+    try:
+        from . import profiler
+
+        snap = profiler.snapshot()
+    except Exception:
+        return None
+    if snap is None:
+        return None
+    snap["stacks"] = snap["stacks"][:top]
+    return snap
 
 
 class FlightRecorder:
@@ -478,6 +502,7 @@ class FlightRecorder:
             "compile_counters": telemetry.compile_counters_snapshot(),
             "stats": self.stats(),
             "profile": profile_path,
+            "profile_snapshot": _profiler_snapshot(),
         }
         errors = validate_bundle(doc)
         if errors:

@@ -1,0 +1,193 @@
+"""Per-stage device micro-profile of the sampled engine (library form).
+
+This is the offline complement to the sampling wall-clock profiler
+(runtime/obs/profiler.py): where the sampler answers "where does wall
+time go across the whole serving path", this module answers "how long
+does each engine stage take on the live device" — key decode,
+geometry, next-use solve, classify, the fixed_k_unique reduction, the
+on-device draw, and the scan-fused whole-buffer kernel, each timed as
+a device-synced telemetry span (`Span.block` under
+`enable(device_sync=True)`; wall alone would time only the async
+dispatch).
+
+`tools/profile_tpu_stages.py` is the CLI wrapper around
+`profile_stages()` — both profiling entry points now live under
+runtime/obs. Pass `profile_hz` to run the sampling profiler over the
+same stage reps and get its snapshot alongside the stage medians, so
+one invocation yields both views of the same work.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def profile_stages(n: int = 512, model: str = "gemm", ref: int = 0,
+                   reps: int = 5, telemetry_out: str | None = None,
+                   profile_hz: float | None = None,
+                   out=print) -> dict:
+    """Time each sampled-engine stage on the claimed device; returns
+    `{"device": ..., "batch": ..., "stage_ms": {stage: median_ms},
+    "profile": snapshot-or-None}` and prints a human summary via
+    `out` (pass `out=lambda *a: None` to silence)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", 1.0
+    )
+    out(f"device: {jax.devices()[0]}")
+
+    from ... import MachineConfig, SamplerConfig
+    from ...core.trace import ProgramTrace
+    from ...models import REGISTRY
+    from ...ops.histogram import fixed_k_unique
+    from ...sampler.sampled import (
+        _best_sink,
+        _sample_geometry,
+        _sample_highs,
+        classify_samples,
+        decode_sample_keys,
+        default_batch,
+    )
+    from .. import telemetry
+    from . import profiler as obs_profiler
+
+    # device_sync=True: each stage span's .block() records the
+    # span-start -> block_until_ready latency as sync_s — the
+    # device-complete time, which is what a stage profile must report
+    tele = telemetry.enable(device_sync=True)
+    prof = (obs_profiler.enable(hz=profile_hz)
+            if profile_hz else None)
+    stage_ms: dict = {}
+
+    def med_time(name, fn, *fn_args, n_reps=reps):
+        """Median device-synced seconds of `n_reps` span-wrapped calls
+        (one warm call first so compile time stays out of the reps —
+        it still lands in the telemetry compile counters)."""
+        jax.block_until_ready(fn(*fn_args))
+        for _ in range(n_reps):
+            with telemetry.span(name, stage=True) as sp:
+                sp.block(fn(*fn_args))
+        ts = sorted(
+            s.sync_s for s in tele.find_spans(name)
+            if s.sync_s is not None
+        )[-n_reps:]
+        med = ts[len(ts) // 2]
+        stage_ms[name] = round(med * 1e3, 3)
+        return med
+
+    machine = MachineConfig()
+    prog = REGISTRY[model](n)
+    trace = ProgramTrace(prog, machine)
+    nt = trace.nests[0]
+    cfg = SamplerConfig(ratio=0.1, seed=0)
+    highs, _ = _sample_highs(nt, ref, cfg)
+    batch = default_batch()
+    rng = np.random.default_rng(0)
+    space = int(np.prod(highs))
+    keys = jnp.asarray(
+        rng.integers(0, space, size=batch, dtype=np.int64)
+    )
+    out(f"batch={batch} highs={highs}")
+
+    result = {
+        "device": str(jax.devices()[0].platform),
+        "model": model,
+        "n": n,
+        "ref": ref,
+        "batch": batch,
+        "stage_ms": stage_ms,
+        "profile": None,
+    }
+
+    dec = jax.jit(lambda k: decode_sample_keys(k, tuple(highs)))
+    t = med_time("decode", dec, keys)
+    out(f"decode:          {t * 1e3:9.2f} ms")
+
+    samples = dec(keys)
+
+    geo = jax.jit(lambda s: _sample_geometry(nt, ref, s))
+    t = med_time("geometry", geo, samples)
+    out(f"geometry:        {t * 1e3:9.2f} ms")
+
+    tid, p0, line, m0 = geo(samples)
+
+    sink = jax.jit(
+        lambda a, b, c, d: _best_sink(nt, ref, a, b, c, d)
+    )
+    t = med_time("best_sink", sink, tid, p0, line, m0)
+    out(f"best_sink:       {t * 1e3:9.2f} ms")
+
+    cls = jax.jit(lambda s: classify_samples(nt, ref, s))
+    t = med_time("classify", cls, samples)
+    out(f"classify (all):  {t * 1e3:9.2f} ms")
+
+    packed, _, _, found = cls(samples)
+    w = jnp.arange(batch, dtype=jnp.int64) < (batch - 7)
+
+    uniq = jax.jit(
+        lambda v, m: fixed_k_unique(v, m, 64), static_argnums=()
+    )
+    t = med_time("fixed_k_unique", uniq, packed, found & w)
+    out(f"fixed_k_unique:  {t * 1e3:9.2f} ms")
+
+    # The redesigned engine's stages: on-device draw (threefry +
+    # sort-dedup + priority thinning) and the scan-fused whole-buffer
+    # kernel — the two dispatches a ref actually costs since the
+    # round-3 transfer redesign.
+    from ...sampler.draw import draw_sample_keys_device
+    from ...sampler.sampled import _build_ref_kernel_scan, _pad_highs
+
+    cfg_draw = SamplerConfig(ratio=0.1, seed=0, device_draw=True)
+    t0 = time.perf_counter()
+    drawn = draw_sample_keys_device(nt, ref, cfg_draw, 0, batch)
+    t_cold = time.perf_counter() - t0
+    if drawn is None:
+        out("device draw:     declined (over budget / empty space)")
+        _finish(result, tele, prof, telemetry_out, out)
+        return result
+    dk, dm, s, dhighs = drawn
+    for r in range(1, reps + 1):
+        with telemetry.span("device_draw", stage=True) as sp:
+            sp.block(draw_sample_keys_device(
+                nt, ref, cfg_draw, r, batch
+            )[0])
+    ts = sorted(
+        sp.sync_s for sp in tele.find_spans("device_draw")
+        if sp.sync_s is not None
+    )
+    med = ts[len(ts) // 2]
+    stage_ms["device_draw"] = round(med * 1e3, 3)
+    out(f"device draw:     {med * 1e3:9.2f} ms  "
+        f"(cold {t_cold:.1f} s; B={dk.shape[0]}, s={s})")
+
+    kscan = _build_ref_kernel_scan(nt, ref)
+    nc = dk.shape[0] // batch
+    t = med_time(
+        "scan_kernel",
+        lambda: kscan(
+            dk, dm, _pad_highs(dhighs), nt.vals, np.int64(ref), 64, nc
+        ),
+        n_reps=min(3, reps),
+    )
+    out(f"scan kernel:     {t * 1e3:9.2f} ms  (n_chunks={nc})")
+    _finish(result, tele, prof, telemetry_out, out)
+    return result
+
+
+def _finish(result: dict, tele, prof, telemetry_out, out) -> None:
+    from .. import telemetry
+    from . import profiler as obs_profiler
+
+    if prof is not None:
+        obs_profiler.disable()
+        result["profile"] = prof.snapshot()
+    telemetry.disable()
+    tele.print_summary()
+    if telemetry_out:
+        tele.write_json(telemetry_out)
+        out(f"telemetry JSON -> {telemetry_out}")

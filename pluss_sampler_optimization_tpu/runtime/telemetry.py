@@ -78,6 +78,20 @@ _lock = lockwitness.make_lock("telemetry._lock")
 _tls = threading.local()
 _current: "Telemetry | None" = None
 
+# Cross-thread span visibility for the sampling profiler
+# (runtime/obs/profiler.py): thread-local span stacks are invisible
+# from any other thread, but the profiler's sampler thread must join
+# `sys._current_frames()` (keyed by thread ident) with "what span is
+# that thread inside right now". Each thread registers its own stack
+# list here (under _lock) the first time it opens a span; the sampler
+# reads a copied snapshot under the same lock. The stack lists
+# themselves are mutated lock-free by their owning thread (append/pop
+# in Span.__enter__/__exit__) — a concurrent reader may observe a
+# stack mid-push and attribute one sample to the parent span instead
+# of the child, which is exactly the tolerance a statistical profiler
+# has anyway.
+_thread_stacks: dict = {}
+
 # Live metrics sink (runtime/obs/metrics.py registry) — when set by
 # metrics.enable(), count()/gauge() mirror every write into it, so the
 # per-run Telemetry and the live serving registry are two views of one
@@ -197,8 +211,40 @@ class Span:
 def _span_stack() -> list:
     stack = getattr(_tls, "stack", None)
     if stack is None:
-        stack = _tls.stack = []
+        stack = _reset_span_stack()
     return stack
+
+
+def _reset_span_stack() -> list:
+    """Install a fresh span stack for the calling thread and register
+    it in the cross-thread registry the profiler samples."""
+    stack = _tls.stack = []
+    with _lock:
+        _thread_stacks[threading.get_ident()] = stack
+    return stack
+
+
+def span_paths_by_thread() -> dict:
+    """Snapshot {thread_ident: "root/child/..."} of every registered
+    thread's current span path ("" when the thread is idle between
+    spans). Prunes entries for threads that no longer exist, so the
+    registry stays bounded by the live thread count. Called from the
+    profiler's sampler thread next to `sys._current_frames()`, which
+    uses the same ident keys."""
+    live = {
+        t.ident for t in threading.enumerate() if t.ident is not None
+    }
+    with _lock:
+        for tid in [t for t in _thread_stacks if t not in live]:
+            del _thread_stacks[tid]
+        snap = {
+            tid: list(stack)
+            for tid, stack in _thread_stacks.items()
+        }
+    return {
+        tid: "/".join(s.name for s in stack)
+        for tid, stack in snap.items()
+    }
 
 
 class Telemetry:
@@ -361,7 +407,7 @@ def enable(device_sync: bool = False) -> Telemetry:
     except Exception:
         pass  # jax absent/broken: spans and counters still work
     tele = Telemetry(device_sync=device_sync)
-    _tls.stack = []
+    _reset_span_stack()
     _current = tele
     return tele
 

@@ -996,6 +996,8 @@ class RequestExecutor:
             "retries": meta["retries"],
             "hedged": meta["hedged"],
         }
+        self._attribute_utilization(outcome, compiles0,
+                                    fetch_s=fetch_s)
         self._observe_stages(outcome, queue_s=queue_s,
                              execute_s=execute_s, fetch_s=fetch_s)
         self._record_flight(request, outcome)
@@ -1026,6 +1028,41 @@ class RequestExecutor:
         ):
             if value is not None:
                 obs_metrics.observe(name, value, exemplar=ex)
+
+    def _attribute_utilization(self, outcome: dict, compiles0,
+                               fetch_s=None) -> None:
+        """Fold the request's stage seconds into a `utilization`
+        block (runtime/obs/attribution.py) on the outcome — wall vs
+        executing vs queue/batch-wait vs fetch, plus the execution's
+        jit-compile seconds when a compile baseline was snapped — and
+        mirror the busy/idle/unattributed fractions into the live
+        gauges. Attribution is observation only: it must never sink
+        the request."""
+        from ..runtime.obs import attribution
+
+        try:
+            compile_s = None
+            if compiles0 is not None:
+                now = telemetry.compile_counters_snapshot()
+                delta = (
+                    now.get("backend_compile_s", 0.0)
+                    - compiles0.get("backend_compile_s", 0.0)
+                )
+                if delta > 0:
+                    compile_s = round(delta, 6)
+            block = attribution.request_utilization(
+                wall_s=outcome.get("latency_s"),
+                execute_s=outcome.get("execute_s"),
+                queue_s=outcome.get("queue_s"),
+                batch_wait_s=outcome.get("batch_wait_s"),
+                fetch_s=fetch_s,
+                compile_s=compile_s,
+            )
+            if block is not None:
+                outcome["utilization"] = block
+                attribution.record_gauges(block)
+        except Exception:
+            self._count("utilization_failed")
 
     def _record_flight(self, request, outcome: dict,
                        extra: dict | None = None) -> None:
@@ -1061,6 +1098,8 @@ class RequestExecutor:
             "replica_id": outcome.get("replica_id"),
             "mrc_digest": outcome.get("mrc_digest"),
         }
+        if outcome.get("utilization") is not None:
+            rec["utilization"] = outcome["utilization"]
         pf = outcome.get("preflight")
         if isinstance(pf, dict) and pf.get("verdict"):
             rec["preflight"] = pf["verdict"]
@@ -1337,6 +1376,7 @@ class RequestExecutor:
         """Ledger + future resolution for one batch member."""
         if e.preflight is not None:
             outcome.setdefault("preflight", e.preflight)
+        self._attribute_utilization(outcome, compiles0)
         self._record_flight(
             e.request, outcome,
             extra=(
@@ -1430,6 +1470,11 @@ class RequestExecutor:
             v = outcome.get(stage)
             if v is not None:
                 row[stage] = round(float(v), 6)
+        # schema-v2 utilization attribution block: stamped only when
+        # the attribution layer produced one, so rows without it keep
+        # their exact pre-attribution bytes
+        if outcome.get("utilization") is not None:
+            row["utilization"] = outcome["utilization"]
         with self._lock:
             row["coalesced"] = self._coalesced_by_fp.pop(
                 fingerprint, 0

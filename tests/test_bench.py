@@ -120,8 +120,9 @@ def test_emit_result_survives_tail_capture(tmp_path, capsys):
     assert doc["value"] == 5.13e6 and doc["vs_baseline"] == 158.4
     assert doc["device"] == "cpu"
     assert doc["periodic_exact_vs"] == 113.71
-    # stamped sidecar: the headline names THIS run's evidence file
-    assert doc["evidence"].startswith("BENCH_EVIDENCE_")
+    # stamped sidecar: the headline names THIS run's evidence file,
+    # filed under bench_out/ so repeated runs don't litter the root
+    assert doc["evidence"].startswith("bench_out/BENCH_EVIDENCE_")
     assert len(line.encode()) <= bench.HEADLINE_MAX_BYTES
     # the full record is still available: earlier stdout line + sidecar
     full = json.loads(out.strip().splitlines()[0])
@@ -196,6 +197,9 @@ def test_bench_emits_json_line(tmp_path):
     # would measure a live serial baseline for minutes here); its
     # engine label is asserted below.
     before = set(os.listdir(REPO))
+    bench_out = os.path.join(REPO, "bench_out")
+    before_out = (set(os.listdir(bench_out))
+                  if os.path.isdir(bench_out) else set())
     with _marker_absent():
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
@@ -203,21 +207,25 @@ def test_bench_emits_json_line(tmp_path):
              "--exact-model", "syrk", "--exact-n", "64"],
             capture_output=True, text=True, timeout=900, cwd=REPO,
         )
-    # the stamped sidecars (evidence + telemetry, + refreshed latest
-    # pointer) land next to bench.py; drop what this test created so
-    # repeat runs stay clean — but first pin the telemetry sidecar's
-    # contract: it exists and validates against the documented schema
+    # the stamped sidecars (evidence + telemetry) land under
+    # bench_out/ (the refreshed latest pointer stays next to bench.py);
+    # drop what this test created so repeat runs stay clean — but first
+    # pin the telemetry sidecar's contract: it exists and validates
+    # against the documented schema
     created = set(os.listdir(REPO)) - before
-    tele_files = [n for n in created if n.startswith("BENCH_TELEMETRY")]
+    created_out = ((set(os.listdir(bench_out))
+                    if os.path.isdir(bench_out) else set()) - before_out)
+    tele_files = [n for n in created_out
+                  if n.startswith("BENCH_TELEMETRY")]
     try:
         assert proc.returncode == 0, proc.stderr[-2000:]
-        assert len(tele_files) == 1, created
+        assert len(tele_files) == 1, created_out
         sys.path.insert(0, os.path.join(REPO, "tools"))
         try:
             import check_telemetry_schema
         finally:
             sys.path.pop(0)
-        with open(os.path.join(REPO, tele_files[0])) as f:
+        with open(os.path.join(bench_out, tele_files[0])) as f:
             tele_doc = json.load(f)
         assert check_telemetry_schema.validate(tele_doc) == []
         assert tele_doc["counters"].get("dispatches", 0) > 0
@@ -238,6 +246,9 @@ def test_bench_emits_json_line(tmp_path):
         # probe-fallback (CPU) run — silent fallback is the hazard
         assert isinstance(last["device_fallback"], bool)
     finally:
+        for name in created_out:
+            if name.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY")):
+                os.remove(os.path.join(bench_out, name))
         for name in created:
             if name.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY")):
                 os.remove(os.path.join(REPO, name))
@@ -254,12 +265,13 @@ def test_bench_emits_json_line(tmp_path):
     assert final["value"] > 0
     assert final["vs_baseline"] > 0
     assert final["device"]
-    assert final["evidence"].startswith("BENCH_EVIDENCE_")
+    assert final["evidence"].startswith("bench_out/BENCH_EVIDENCE_")
     # the analytic secondary row reaches the tail with its engine label
     assert final["exact_secondary"]["engine"] == "analytic"
     doc = json.loads(json_lines[0])  # the full record
     # evidence names its telemetry sidecar so the two cross-reference
-    assert doc["extra"]["telemetry"].startswith("BENCH_TELEMETRY_")
+    assert doc["extra"]["telemetry"].startswith(
+        "bench_out/BENCH_TELEMETRY_")
     # ... and the run-ledger path, closing the evidence<->ledger loop
     assert doc["extra"]["ledger"] == "LEDGER.jsonl"
     assert doc["extra"]["mrc_digest"]
@@ -334,6 +346,9 @@ def test_bench_require_accelerator_refuses_cpu():
     must exit 2 BEFORE benchmarking (no evidence/telemetry sidecars,
     no ledger row — a refused run leaves nothing to misfile)."""
     before = set(os.listdir(REPO))
+    bench_out = os.path.join(REPO, "bench_out")
+    before_out = (set(os.listdir(bench_out))
+                  if os.path.isdir(bench_out) else set())
     with _marker_absent():
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
@@ -344,8 +359,10 @@ def test_bench_require_accelerator_refuses_cpu():
     assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
     assert "--require-accelerator" in proc.stderr
     created = set(os.listdir(REPO)) - before
+    created_out = ((set(os.listdir(bench_out))
+                    if os.path.isdir(bench_out) else set()) - before_out)
     assert not any(
         n.startswith(("BENCH_EVIDENCE", "BENCH_TELEMETRY"))
         or n == "LEDGER.jsonl"
-        for n in created
-    ), created
+        for n in created | created_out
+    ), (created, created_out)

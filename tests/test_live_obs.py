@@ -37,6 +37,7 @@ from pluss_sampler_optimization_tpu.runtime.obs import (
     exporters,
     ledger as obs_ledger,
     metrics as obs_metrics,
+    profiler as obs_profiler,
     slo as obs_slo,
 )
 from pluss_sampler_optimization_tpu.sampler.sampled import run_sampled
@@ -62,9 +63,11 @@ import check_slo  # noqa: E402
 def _clean_slate():
     telemetry.disable()
     obs_metrics.disable()
+    obs_profiler.disable()
     yield
     telemetry.disable()
     obs_metrics.disable()
+    obs_profiler.disable()
 
 
 def _req(**kw):
@@ -219,6 +222,96 @@ def test_metrics_server_scrapes_live_registry():
     # after close() the port no longer answers
     with pytest.raises(Exception):
         urllib.request.urlopen(url, timeout=0.5)
+
+
+def test_metrics_server_profile_route_off_is_structured_404():
+    """With no profiler running, /debug/profile answers a machine-
+    readable JSON 404 body — pollers must never have to parse the
+    stdlib HTML error page to learn the profiler is off."""
+    reg = obs_metrics.MetricsRegistry()
+    with obs_metrics.MetricsServer(
+        reg, port=0, profile=obs_profiler.snapshot
+    ) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/debug/profile",
+                timeout=10,
+            )
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read().decode())
+        assert body["error"] == "profiler not running"
+        assert body["status"] == 404
+        assert "--profile-hz" in body["hint"]
+
+
+def test_metrics_server_concurrent_scrapes_during_execution():
+    """N parallel scrapers hammering /metrics and /debug/profile while
+    spans execute: every response is a well-formed 200, every profile
+    snapshot validates — concurrent scrapes must never corrupt or
+    crash the registry/profiler read paths."""
+    telemetry.enable()
+    reg = obs_metrics.enable()
+    prof = obs_profiler.enable(hz=300.0)
+    stop = threading.Event()
+
+    def busy_requests():
+        while not stop.is_set():
+            with telemetry.span("service_request", engine="sampled"):
+                with telemetry.span("execute"):
+                    telemetry.count("scrape_test_reqs")
+                    sum(range(2000))
+
+    failures: list = []
+    snapshots: list = []
+
+    def scraper(base):
+        try:
+            for _ in range(5):
+                with urllib.request.urlopen(
+                    base + "/metrics", timeout=10
+                ) as resp:
+                    assert resp.status == 200
+                    assert "pluss_" in resp.read().decode()
+                with urllib.request.urlopen(
+                    base + "/debug/profile", timeout=10
+                ) as resp:
+                    assert resp.status == 200
+                    snap = json.loads(resp.read().decode())
+                    errs = obs_profiler.validate_snapshot(snap)
+                    assert errs == [], errs
+                    snapshots.append(snap)
+        except Exception as e:  # pragma: no cover - failure detail
+            failures.append(repr(e))
+
+    worker = threading.Thread(target=busy_requests, daemon=True)
+    worker.start()
+    try:
+        with obs_metrics.MetricsServer(
+            reg, port=0, profile=obs_profiler.snapshot
+        ) as srv:
+            base = f"http://{srv.host}:{srv.port}"
+            scrapers = [
+                threading.Thread(target=scraper, args=(base,))
+                for _ in range(6)
+            ]
+            for t in scrapers:
+                t.start()
+            for t in scrapers:
+                t.join(timeout=60)
+    finally:
+        stop.set()
+        worker.join(timeout=10)
+        obs_profiler.disable()
+    assert not failures, failures
+    assert len(snapshots) == 30
+    # snapshots are monotone: later scrapes never report fewer samples
+    # than earlier ones from the same collector (consistency under
+    # concurrent folding)
+    assert all(s["profile_version"] == obs_profiler.PROFILE_VERSION
+               for s in snapshots)
+    assert max(s["samples"] for s in snapshots) >= 1
+    final = prof.snapshot()
+    assert final["samples_attributed"] >= 1
 
 
 # -- serve surface ----------------------------------------------------

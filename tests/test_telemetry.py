@@ -341,10 +341,21 @@ def test_cli_telemetry_out(tmp_path, capsys, mode, n, extra_args):
 
 def test_cli_profile_dir(tmp_path):
     """--profile-dir wraps the run in jax.profiler.trace and leaves a
-    trace artifact behind."""
+    trace artifact behind.  Runs in a fresh interpreter: the trace
+    dump covers everything the process ever compiled, so in-process
+    it inflates from ~8s standalone to minutes late in the suite."""
+    import subprocess
+
     prof = str(tmp_path / "prof")
-    assert main(["acc", "--model", "gemm", "--n", "8", "--engine",
-                 "dense", "--profile-dir", prof]) == 0
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pluss_sampler_optimization_tpu",
+         "acc", "--model", "gemm", "--n", "8", "--engine", "dense",
+         "--platform", "cpu", "--profile-dir", prof],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
     found = []
     for root, _dirs, files in os.walk(prof):
         found += files

@@ -1,15 +1,11 @@
 """Micro-profile the sampled engine's per-batch stages on the live device.
 
-Splits one ref's dispatch into its stages — key decode, geometry,
-next-use solve, classify, the fixed_k_unique reduction, the device
-draw, and the scan-fused whole-buffer kernel — and times each at the
-default accelerator batch size, so "the engine is slow on X" resolves
-to the stage that actually is. Built on the shared telemetry layer
-(runtime/telemetry.py): every stage rep is a device-synced span
-(`Span.block` under `enable(device_sync=True)`), the printed medians
-are read back off the recorded span tree, and `--telemetry-out`
-exports the whole run in the standard schema for offline diffing.
-Run on the bench host:
+Thin CLI wrapper: the stage-profiling logic lives in the profiler
+layer (pluss_sampler_optimization_tpu/runtime/obs/stage_profile.py),
+next to the sampling wall-clock profiler (runtime/obs/profiler.py) —
+one profiling entry point, two views. This script keeps the historic
+command line working and adds --profile-hz to run the sampling
+profiler over the same stage reps. Run on the bench host:
 
     JAX_PLATFORMS=tpu python tools/profile_tpu_stages.py [--n 512]
 """
@@ -19,7 +15,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -35,147 +30,30 @@ def main() -> int:
     ap.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="also write the run's full telemetry JSON "
                     "(schema: README \"Observability\")")
+    ap.add_argument("--profile-hz", type=float, default=None,
+                    metavar="HZ",
+                    help="also run the sampling wall-clock profiler "
+                    "over the stage reps and print its span-seconds "
+                    "summary (runtime/obs/profiler.py)")
     args = ap.parse_args()
 
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-
-    jax.config.update("jax_compilation_cache_dir", ".jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    print("device:", jax.devices()[0])
-
-    from pluss_sampler_optimization_tpu import MachineConfig, SamplerConfig
-    from pluss_sampler_optimization_tpu.core.trace import ProgramTrace
-    from pluss_sampler_optimization_tpu.models import REGISTRY
-    from pluss_sampler_optimization_tpu.ops.histogram import fixed_k_unique
-    from pluss_sampler_optimization_tpu.runtime import telemetry
-    from pluss_sampler_optimization_tpu.sampler.sampled import (
-        _best_sink,
-        _sample_geometry,
-        _sample_highs,
-        classify_samples,
-        decode_sample_keys,
-        default_batch,
+    from pluss_sampler_optimization_tpu.runtime.obs.stage_profile import (
+        profile_stages,
     )
 
-    # device_sync=True: each stage span's .block() records the
-    # span-start -> block_until_ready latency as sync_s — the
-    # device-complete time, which is what a stage profile must report
-    # (wall alone would time only the async dispatch)
-    tele = telemetry.enable(device_sync=True)
-
-    def med_time(name, fn, *fn_args, reps=args.reps):
-        """Median device-synced seconds of `reps` span-wrapped calls
-        (one warm call first so compile time stays out of the reps —
-        it still lands in the telemetry compile counters)."""
-        jax.block_until_ready(fn(*fn_args))
-        for _ in range(reps):
-            with telemetry.span(name, stage=True) as sp:
-                sp.block(fn(*fn_args))
-        ts = sorted(
-            s.sync_s for s in tele.find_spans(name)
-            if s.sync_s is not None
-        )[-reps:]
-        return ts[len(ts) // 2]
-
-    machine = MachineConfig()
-    prog = REGISTRY[args.model](args.n)
-    trace = ProgramTrace(prog, machine)
-    nt = trace.nests[0]
-    cfg = SamplerConfig(ratio=0.1, seed=0)
-    highs, _ = _sample_highs(nt, args.ref, cfg)
-    batch = default_batch()
-    rng = np.random.default_rng(0)
-    space = int(np.prod(highs))
-    keys = jnp.asarray(rng.integers(0, space, size=batch, dtype=np.int64))
-    print(f"batch={batch} highs={highs}")
-
-    dec = jax.jit(lambda k: decode_sample_keys(k, tuple(highs)))
-    t = med_time("decode", dec, keys)
-    print(f"decode:          {t * 1e3:9.2f} ms")
-
-    samples = dec(keys)
-
-    geo = jax.jit(lambda s: _sample_geometry(nt, args.ref, s))
-    t = med_time("geometry", geo, samples)
-    print(f"geometry:        {t * 1e3:9.2f} ms")
-
-    tid, p0, line, m0 = geo(samples)
-
-    sink = jax.jit(lambda a, b, c, d: _best_sink(nt, args.ref, a, b, c, d))
-    t = med_time("best_sink", sink, tid, p0, line, m0)
-    print(f"best_sink:       {t * 1e3:9.2f} ms")
-
-    cls = jax.jit(lambda s: classify_samples(nt, args.ref, s))
-    t = med_time("classify", cls, samples)
-    print(f"classify (all):  {t * 1e3:9.2f} ms")
-
-    packed, _, _, found = cls(samples)
-    w = jnp.arange(batch, dtype=jnp.int64) < (batch - 7)
-
-    uniq = jax.jit(
-        lambda v, m: fixed_k_unique(v, m, 64), static_argnums=()
+    result = profile_stages(
+        n=args.n, model=args.model, ref=args.ref, reps=args.reps,
+        telemetry_out=args.telemetry_out,
+        profile_hz=args.profile_hz,
     )
-    t = med_time("fixed_k_unique", uniq, packed, found & w)
-    print(f"fixed_k_unique:  {t * 1e3:9.2f} ms")
-
-    # The redesigned engine's stages: on-device draw (threefry +
-    # sort-dedup + priority thinning) and the scan-fused whole-buffer
-    # kernel — the two dispatches a ref actually costs since the
-    # round-3 transfer redesign.
-    from pluss_sampler_optimization_tpu.sampler.draw import (
-        draw_sample_keys_device,
-    )
-    from pluss_sampler_optimization_tpu.sampler.sampled import (
-        _build_ref_kernel_scan,
-        _pad_highs,
-    )
-
-    cfg_draw = SamplerConfig(ratio=0.1, seed=0, device_draw=True)
-    t0 = time.perf_counter()
-    drawn = draw_sample_keys_device(nt, args.ref, cfg_draw, 0, batch)
-    t_cold = time.perf_counter() - t0
-    if drawn is None:
-        print("device draw:     declined (over budget / empty space)")
-        _finish(tele, args)
-        return 0
-    dk, dm, s, dhighs = drawn
-    for r in range(1, args.reps + 1):
-        with telemetry.span("device_draw", stage=True) as sp:
-            sp.block(draw_sample_keys_device(
-                nt, args.ref, cfg_draw, r, batch
-            )[0])
-    ts = sorted(
-        sp.sync_s for sp in tele.find_spans("device_draw")
-        if sp.sync_s is not None
-    )
-    print(f"device draw:     {ts[len(ts) // 2] * 1e3:9.2f} ms  "
-          f"(cold {t_cold:.1f} s; B={dk.shape[0]}, s={s})")
-
-    kscan = _build_ref_kernel_scan(nt, args.ref)
-    nc = dk.shape[0] // batch
-    t = med_time(
-        "scan_kernel",
-        lambda: kscan(
-            dk, dm, _pad_highs(dhighs), nt.vals, np.int64(args.ref), 64, nc
-        ),
-        reps=min(3, args.reps),
-    )
-    print(f"scan kernel:     {t * 1e3:9.2f} ms  (n_chunks={nc})")
-    _finish(tele, args)
+    snap = result.get("profile")
+    if snap is not None:
+        print(f"profiler: {snap['samples']} samples @ {snap['hz']} Hz")
+        for path, secs in sorted(
+            snap["span_seconds"].items(), key=lambda kv: -kv[1]
+        )[:10]:
+            print(f"  {path:<40s} {secs:8.3f} s")
     return 0
-
-
-def _finish(tele, args) -> None:
-    from pluss_sampler_optimization_tpu.runtime import telemetry
-
-    telemetry.disable()
-    tele.print_summary()
-    if args.telemetry_out:
-        tele.write_json(args.telemetry_out)
-        print(f"telemetry JSON -> {args.telemetry_out}")
 
 
 if __name__ == "__main__":
