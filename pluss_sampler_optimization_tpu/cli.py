@@ -238,7 +238,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pluss_sampler_optimization_tpu")
     ap.add_argument("mode", nargs="?",
                     choices=["acc", "speed", "sample", "trace",
-                             "serve", "stats", "analyze"])
+                             "serve", "serve-worker", "serve-router",
+                             "stats", "analyze"])
     ap.add_argument("--list-models", action="store_true",
                     help="print the model registry (nest/ref geometry "
                     "+ exact-router analytic audit status, from "
@@ -730,6 +731,91 @@ def main(argv=None) -> int:
         help="with --ledger-gc-interval-s: keep only the newest N "
         "rows at each GC pass (0 = drop only invalid lines)",
     )
+    ap.add_argument(
+        "--listen",
+        default=None,
+        metavar="HOST:PORT",
+        help="serve-worker: bind the fabric wire listener here "
+        "(default 127.0.0.1:0 = ephemeral; the bound address prints "
+        "as a 'fabric-worker ready' line on stdout). serve-router: "
+        "additionally accept plain JSONL TCP clients here (loadgen "
+        "--connect drives it); without it the router serves the "
+        "--requests batch only. See README \"Multi-process serving\".",
+    )
+    ap.add_argument(
+        "--worker",
+        action="append",
+        default=None,
+        metavar="HOST:PORT",
+        help="serve-router: a fabric worker's wire address "
+        "(repeatable — one per externally-launched serve-worker "
+        "process). Mutually exclusive with --workers.",
+    )
+    ap.add_argument(
+        "--workers",
+        type=int,
+        default=None,
+        metavar="N",
+        help="serve-router: supervise N serve-worker subprocesses "
+        "(ephemeral ports, worker ids 0..N-1), forwarding the "
+        "service flags (--cache-dir is the SHARED disk tier, "
+        "--ledger the shared run ledger) and reaping every child on "
+        "exit — zero orphans. Mutually exclusive with --worker.",
+    )
+    ap.add_argument(
+        "--worker-id",
+        type=int,
+        default=0,
+        metavar="K",
+        help="serve-worker: this worker's id — its position in the "
+        "router's consistent-hash ring and the worker_id stamped on "
+        "its ledger rows (default 0; the --workers supervisor "
+        "assigns 0..N-1)",
+    )
+    ap.add_argument(
+        "--worker-devices",
+        type=int,
+        default=None,
+        metavar="D",
+        help="serve-worker: pin this worker to a virtual D-device "
+        "CPU slice (xla_force_host_platform_device_count, applied "
+        "before jax initializes — CPU platform only; cross-host "
+        "device slicing via jax.distributed is the ROADMAP "
+        "residual). With --workers the supervisor forwards it to "
+        "every child.",
+    )
+    ap.add_argument(
+        "--hb-interval-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="fabric heartbeat period: the router pings every link "
+        "this often (default 2)",
+    )
+    ap.add_argument(
+        "--hb-timeout-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="fabric liveness bound: a link silent this long is "
+        "declared failed and reconnected (default 10)",
+    )
+    ap.add_argument(
+        "--reconnect-attempts",
+        type=int,
+        default=None,
+        metavar="N",
+        help="fabric: consecutive failed reconnects before a worker "
+        "is declared DEAD and its in-flight requests re-dispatch to "
+        "each fingerprint's ring successor (default 3)",
+    )
+    ap.add_argument(
+        "--reconnect-delay-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="fabric: pause between reconnect attempts (default 0.2)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_models:
@@ -742,10 +828,13 @@ def main(argv=None) -> int:
         ap.error("mode is required (acc|speed|sample|trace|serve|"
                  "stats|analyze)")
 
-    if args.program_json and args.mode in ("serve", "trace", "stats"):
+    _SERVE_FAMILY = ("serve", "serve-worker", "serve-router")
+    if args.program_json and args.mode in (
+        "trace", "stats", *_SERVE_FAMILY
+    ):
         raise SystemExit(
             "--program-json loads an inline frontend document for "
-            "acc|speed|sample|analyze; serve mode takes a 'program' "
+            "acc|speed|sample|analyze; serve modes take a 'program' "
             "field per request line instead"
         )
 
@@ -773,7 +862,66 @@ def main(argv=None) -> int:
             )
         )
 
-    if args.mode != "serve":
+    _fabric_flags = [
+        flag for flag, on in (
+            ("--listen", args.listen is not None),
+            ("--worker", args.worker is not None),
+            ("--workers", args.workers is not None),
+            ("--worker-id", args.worker_id != 0),
+            ("--worker-devices", args.worker_devices is not None),
+            ("--hb-interval-s", args.hb_interval_s is not None),
+            ("--hb-timeout-s", args.hb_timeout_s is not None),
+            ("--reconnect-attempts",
+             args.reconnect_attempts is not None),
+            ("--reconnect-delay-s",
+             args.reconnect_delay_s is not None),
+        ) if on
+    ]
+    if _fabric_flags and args.mode not in ("serve-worker",
+                                           "serve-router"):
+        raise SystemExit(
+            f"{', '.join(_fabric_flags)} configure(s) the serving "
+            "fabric; they apply to serve-worker/serve-router only"
+        )
+    if args.mode == "serve-router":
+        if (args.worker is None) == (args.workers is None):
+            raise SystemExit(
+                "serve-router needs exactly one of --worker "
+                "HOST:PORT (repeatable, external workers) or "
+                "--workers N (supervised subprocesses)"
+            )
+        if args.workers is not None and args.workers < 1:
+            raise SystemExit("--workers must be >= 1")
+        _worker_side = [
+            flag for flag, on in (
+                ("--profile-hz", args.profile_hz is not None),
+                ("--slo-latency-p95-s",
+                 args.slo_latency_p95_s is not None),
+                ("--slo-error-budget",
+                 args.slo_error_budget is not None),
+                ("--regress-bench", args.regress_bench is not None),
+                ("--ledger-gc-interval-s",
+                 args.ledger_gc_interval_s is not None),
+            ) if on
+        ]
+        if _worker_side:
+            raise SystemExit(
+                f"{', '.join(_worker_side)} observe engine "
+                "execution; run them on the serve-worker processes "
+                "(the router executes no engine work)"
+            )
+    if args.mode == "serve-worker":
+        if args.worker is not None or args.workers is not None:
+            raise SystemExit(
+                "--worker/--workers describe the router's worker "
+                "set; serve-worker takes --listen/--worker-id"
+            )
+        if args.worker_id < 0:
+            raise SystemExit("--worker-id must be >= 0")
+        if args.worker_devices is not None and args.worker_devices < 1:
+            raise SystemExit("--worker-devices must be >= 1")
+
+    if args.mode not in _SERVE_FAMILY:
         if args.warmup_from_ledger is not None:
             raise SystemExit(
                 "--warmup-from-ledger pre-compiles serving kernels at "
@@ -859,8 +1007,10 @@ def main(argv=None) -> int:
             "run ledger; it needs --ledger PATH"
         )
 
-    if args.mode == "serve":
+    if args.mode in ("serve", "serve-worker"):
         return _observed(args, lambda: _serve(args))
+    if args.mode == "serve-router":
+        return _observed(args, lambda: _serve_router(args))
 
     from .config import MachineConfig
 
@@ -1136,6 +1286,308 @@ def _resilience_from_args(args):
     return ResilienceConfig(**kw)
 
 
+def _fabric_from_args(args):
+    """FabricConfig from the CLI timing flags (defaults where unset)."""
+    from .config import FabricConfig
+
+    kw = {}
+    for attr in ("hb_interval_s", "hb_timeout_s",
+                 "reconnect_attempts", "reconnect_delay_s"):
+        v = getattr(args, attr)
+        if v is not None:
+            kw[attr] = v
+    return FabricConfig(**kw)
+
+
+def _run_worker_front(args, svc) -> int:
+    """The serve-worker serving front: a fabric WorkerServer over the
+    already-wired AnalysisService. Blocks until the router drains this
+    worker (`shutdown` frame -> bye) or SIGTERM/SIGINT lands; either
+    way the service enters graceful drain so _serve's shutdown
+    reporting (and final flight-recorder bundle) fires."""
+    from .service import GracefulShutdown
+    from .service.fabric import WorkerServer, parse_hostport
+
+    host, port = ("127.0.0.1", 0)
+    if args.listen:
+        host, port = parse_hostport(args.listen)
+    ws = WorkerServer(
+        svc, worker_id=args.worker_id, host=host, port=port,
+        fabric=_fabric_from_args(args),
+    )
+    host, port = ws.start()
+    # the supervisor (serve-router --workers N) parses this exact
+    # stdout line to learn the ephemeral port — keep it first + flushed
+    print(f"fabric-worker ready {args.worker_id} {host}:{port}",
+          flush=True)
+    print(
+        f"serve-worker: worker {args.worker_id} speaking the fabric "
+        f"wire protocol on {host}:{port}",
+        file=sys.stderr,
+    )
+    try:
+        while not ws.join_drained(timeout=0.5):
+            pass
+        svc.begin_shutdown()
+    except GracefulShutdown:
+        svc.begin_shutdown()
+        ws.drain_local()
+    finally:
+        ws.close()
+    return 0
+
+
+def _spawn_workers(args, children) -> list:
+    """serve-router --workers N: launch N serve-worker subprocesses
+    on ephemeral ports (worker ids 0..N-1), forwarding the service
+    flags — ONE shared --cache-dir disk tier and ONE shared O_APPEND
+    --ledger across the fleet — and return their wire addresses.
+    Children are appended to `children` as they spawn so the caller's
+    cleanup reaps every process even when a later one fails to come
+    up (the zero-orphans guarantee tools/check_fabric.py pins)."""
+    import subprocess
+
+    from .service.fabric import parse_hostport
+
+    forwarded = []
+    for flag, value in (
+        ("--cache-dir", args.cache_dir),
+        ("--ledger", args.ledger),
+        ("--max-workers", args.max_workers),
+        ("--batch-window-ms", args.batch_window_ms),
+        ("--batch-max-refs", args.batch_max_refs),
+        ("--replicas", args.replicas),
+        ("--worker-devices", args.worker_devices),
+        ("--platform", args.platform),
+        ("--compilation-cache-dir", args.compilation_cache_dir),
+        ("--warmup-from-ledger", args.warmup_from_ledger),
+        ("--fault-spec", args.fault_spec),
+        ("--attempt-timeout-s", args.attempt_timeout_s),
+        ("--max-retries", args.max_retries),
+        ("--hedge-after-s", args.hedge_after_s),
+        ("--queue-limit", args.queue_limit),
+        ("--breaker-failures", args.breaker_failures),
+        ("--breaker-probation-s", args.breaker_probation_s),
+        ("--hb-interval-s", args.hb_interval_s),
+        ("--hb-timeout-s", args.hb_timeout_s),
+        ("--reconnect-attempts", args.reconnect_attempts),
+        ("--reconnect-delay-s", args.reconnect_delay_s),
+    ):
+        if value is not None and flag != "--batch-max-refs":
+            forwarded += [flag, str(value)]
+        elif flag == "--batch-max-refs" and args.batch_window_ms:
+            forwarded += [flag, str(value)]
+    if args.no_shed:
+        forwarded.append("--no-shed")
+    addrs = []
+    for i in range(args.workers):
+        cmd = [
+            sys.executable, "-m", "pluss_sampler_optimization_tpu.cli",
+            "serve-worker", "--listen", "127.0.0.1:0",
+            "--worker-id", str(i),
+        ] + forwarded
+        if args.debug_bundle_dir is not None:
+            import os
+
+            cmd += ["--debug-bundle-dir",
+                    os.path.join(args.debug_bundle_dir, f"worker{i}")]
+        children.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, text=True, bufsize=1,
+        ))
+    for i, proc in enumerate(children):
+        line = proc.stdout.readline().strip()
+        parts = line.split()
+        if (len(parts) != 4 or parts[:2] != ["fabric-worker", "ready"]
+                or parts[2] != str(i)):
+            raise SystemExit(
+                f"serve-router: worker {i} failed to start "
+                f"(got {line!r} instead of its ready line)"
+            )
+        addrs.append(parse_hostport(parts[3]))
+        print(f"serve-router: worker {i} up at {parts[3]} "
+              f"(pid {proc.pid})", file=sys.stderr)
+    return addrs
+
+
+def _serve_router(args) -> int:
+    """`serve-router` mode: the fabric's dispatch plane — consistent-
+    hash request fingerprints over N engine workers (supervised
+    subprocesses via --workers, or externally-launched via --worker),
+    serving the JSONL protocol from --requests/stdin and, with
+    --listen, from TCP clients. SIGTERM/SIGINT drain the WHOLE
+    fabric: the router stops accepting, in-flight entries resolve,
+    every worker gets a `shutdown` frame and drains (each dumping its
+    own final flight-recorder bundle when armed), and supervised
+    children are reaped — zero orphans."""
+    import signal
+
+    from .runtime import faults
+    from .runtime.obs import metrics as obs_metrics
+    from .runtime.obs import profiler as obs_profiler
+    from .runtime.obs import recorder as obs_recorder
+    from .service import GracefulShutdown
+    from .service.fabric import Router, parse_hostport
+
+    fabric = _fabric_from_args(args)
+    fin = sys.stdin if args.requests == "-" else open(args.requests)
+    fout = (
+        sys.stdout if args.responses == "-"
+        else open(args.responses, "w")
+    )
+    registry = obs_metrics.enable()
+    injector = None
+    recorder = None
+    router = None
+    server = None
+    children: list = []
+    prev_sigs = {}
+    failures = 0
+    graceful = False
+    if args.fault_spec:
+        # the router arms its own injector for the worker_conn site;
+        # supervised workers get --fault-spec forwarded and draw from
+        # their own (identically-seeded) streams
+        injector = faults.install_from_file(args.fault_spec)
+        print(
+            f"serve-router: fault injection armed from "
+            f"{args.fault_spec} (seed {injector.config.seed}, "
+            f"{len(injector.config.rules)} rule(s))",
+            file=sys.stderr,
+        )
+    if args.debug_bundle_dir is not None:
+        recorder = obs_recorder.enable(
+            args.debug_bundle_dir,
+            ledger_path=args.ledger,
+            config={
+                k: getattr(args, k)
+                for k in (
+                    "cache_dir", "ledger", "workers", "worker",
+                    "listen", "hb_interval_s", "hb_timeout_s",
+                    "reconnect_attempts", "reconnect_delay_s",
+                    "fault_spec", "debug_bundle_dir",
+                )
+            },
+        )
+        print(
+            "serve-router: flight recorder on, post-mortem bundles "
+            f"under {args.debug_bundle_dir}",
+            file=sys.stderr,
+        )
+    try:
+        def _graceful_sig(signum, frame):
+            raise GracefulShutdown(f"signal {signum}")
+
+        for _name in ("SIGTERM", "SIGINT"):
+            _num = getattr(signal, _name, None)
+            if _num is None:
+                continue
+            try:
+                prev_sigs[_num] = signal.signal(_num, _graceful_sig)
+            except ValueError:
+                pass
+        if args.workers is not None:
+            addrs = _spawn_workers(args, children)
+        else:
+            addrs = [parse_hostport(spec) for spec in args.worker]
+        router = Router(addrs, fabric=fabric).start()
+        if recorder is not None:
+            recorder.state_provider = lambda: {
+                "healthz": router.healthz(),
+                "stats": router.stats(),
+            }
+        if args.metrics_port is not None:
+            server = obs_metrics.MetricsServer(
+                registry, port=args.metrics_port,
+                healthz=router.healthz, stats=router.stats,
+                bundles=(
+                    (lambda: {
+                        "bundle_dir": recorder.bundle_dir,
+                        "recorder": recorder.stats(),
+                        "bundles": recorder.bundle_index(),
+                    }) if recorder is not None else None
+                ),
+                profile=obs_profiler.snapshot,
+            )
+            print(
+                f"serve-router: live metrics on "
+                f"http://{server.host}:{server.port}/metrics",
+                file=sys.stderr,
+            )
+        if args.listen is not None:
+            th, tp = parse_hostport(args.listen)
+            bh, bp = router.serve_tcp(th, tp)
+            print(f"serve-router: JSONL TCP front on {bh}:{bp}",
+                  file=sys.stderr)
+        if args.requests != "-" or args.listen is None:
+            failures = router.serve_stream(fin, fout)
+        if args.listen is not None:
+            # TCP daemon: serve until a shutdown signal lands
+            import threading as _threading
+
+            _forever = _threading.Event()
+            while not _forever.wait(0.5):
+                pass
+    except GracefulShutdown:
+        graceful = True
+        print(
+            "serve-router: graceful shutdown — stopped accepting, "
+            "draining the fabric",
+            file=sys.stderr,
+        )
+    finally:
+        if router is not None:
+            router.close(graceful=True)
+        for proc in children:
+            try:
+                proc.wait(timeout=fabric.drain_timeout_s)
+            except Exception:
+                proc.kill()
+                proc.wait()
+            if proc.stdout is not None:
+                proc.stdout.close()
+        if graceful and recorder is not None:
+            recorder.dump(
+                "shutdown", trigger={"reason": "graceful_shutdown"}
+            )
+        if injector is not None:
+            if injector.total_fired():
+                print(
+                    f"serve-router: faults fired "
+                    f"{injector.total_fired()} time(s): "
+                    f"{injector.stats()}",
+                    file=sys.stderr,
+                )
+            faults.uninstall()
+        if prev_sigs:
+            for _num, _prev in prev_sigs.items():
+                try:
+                    signal.signal(_num, _prev)
+                except ValueError:
+                    pass
+        if server is not None:
+            server.close()
+        if recorder is not None:
+            obs_recorder.disable()
+        obs_metrics.disable()
+        if fin is not sys.stdin:
+            fin.close()
+        if fout is not sys.stdout:
+            fout.close()
+    if graceful and children:
+        print(
+            f"serve-router: graceful shutdown complete — "
+            f"{len(children)} worker(s) drained and reaped",
+            file=sys.stderr,
+        )
+    if failures:
+        print(
+            f"serve-router: {failures} request(s) failed (per-line "
+            "status is in the responses)",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def _serve(args) -> int:
     """`serve` mode: process a JSONL request batch end to end, under
     the live metrics registry (always on here — the `metrics` request
@@ -1145,13 +1597,29 @@ def _serve(args) -> int:
     when armed — deterministic fault injection (--fault-spec).
     SIGTERM/SIGINT trigger a graceful drain: in-flight work finishes,
     queued work is shed with structured responses, and the ledger
-    (plus a final flight-recorder bundle) is flushed before exit."""
+    (plus a final flight-recorder bundle) is flushed before exit.
+
+    `serve-worker` mode runs HERE too — the identical stack and
+    wiring, with the serving front swapped: instead of a JSONL batch
+    from --requests, the service answers framed request lines from a
+    fabric router (service/fabric/worker.py) until the router drains
+    it or a signal lands. Same per-line semantics, same responses,
+    same observability — which is what makes fabric results
+    bit-identical to single-process serve."""
     from .runtime import faults
     from .runtime.obs import ledger as obs_ledger
     from .runtime.obs import metrics as obs_metrics
     from .runtime.obs import profiler as obs_profiler
     from .runtime.obs import recorder as obs_recorder
     from .service import AnalysisService, GracefulShutdown, serve_jsonl
+
+    worker_mode = args.mode == "serve-worker"
+    if worker_mode and args.worker_devices:
+        # must land before ANY jax backend touch — the virtual CPU
+        # slice is baked into XLA_FLAGS at client creation
+        from . import _platform
+
+        _platform.force_virtual_cpu(args.worker_devices)
 
     fin = sys.stdin if args.requests == "-" else open(args.requests)
     fout = (
@@ -1253,6 +1721,7 @@ def _serve(args) -> int:
             batch_max_refs=args.batch_max_refs,
             replicas=args.replicas,
             resilience=_resilience_from_args(args),
+            worker_id=(args.worker_id if worker_mode else None),
         ) as svc:
             if recorder is not None:
                 # live serving state for bundles: replica/mesh view +
@@ -1318,7 +1787,10 @@ def _serve(args) -> int:
                     regress_bench=bench_paths,
                 ).start()
                 svc.slo_sentinel = sentinel
-            failures = serve_jsonl(svc, fin, fout)
+            if worker_mode:
+                failures = _run_worker_front(args, svc)
+            else:
+                failures = serve_jsonl(svc, fin, fout)
             if svc.executor.draining:
                 st = svc.executor.stats()
                 print(

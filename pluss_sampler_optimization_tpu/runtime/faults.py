@@ -8,6 +8,10 @@ Every hot path of the serving stack carries a NAMED injection site:
     cache_load        service/cache.py — a disk-tier record read
     cache_store       service/cache.py — a disk-tier record write
     serve_line        service/api.py — one serve_jsonl request line
+    worker_conn       service/fabric/router.py — one frame send on a
+                      router->worker link
+    worker_exec       service/fabric/worker.py — one request frame
+                      received by a worker
 
 With no injector installed (the default), every site is a two-opcode
 no-op — `fire()` returns on a single module-global None check, so the
@@ -33,6 +37,12 @@ of what other threads did in between. Fault kinds:
     corrupt          cache_load only (`mangle()`): the parsed record
                      is replaced with one that fails validation, so
                      the loader's quarantine path fires
+    disconnect       fabric sites: the site raises DisconnectFault —
+                     the router treats it as a link failure (bounded
+                     reconnect, then re-dispatch to the ring
+                     successor), a worker abruptly drops its router
+                     connection (the partition-blip scenario
+                     tools/check_chaos.py pins)
 
 The same module hosts the SEEDED retry jitter (`backoff_delay`):
 deterministic exponential backoff whose jitter comes from the same
@@ -59,6 +69,12 @@ class FaultInjected(RuntimeError):
 
 class CompileFault(FaultInjected):
     """An injected compile failure (kind "compile_failure")."""
+
+
+class DisconnectFault(FaultInjected):
+    """An injected connection drop (kind "disconnect" at the fabric
+    sites): the catcher severs the affected socket instead of
+    answering, exercising the reconnect/re-dispatch path."""
 
 
 _MASK = (1 << 64) - 1
@@ -217,7 +233,7 @@ def fire(site: str, key=None, **ctx) -> None:
         return
     rule = inj.match(
         site, key, kinds=("raise", "latency", "hang",
-                          "compile_failure"), **ctx
+                          "compile_failure", "disconnect"), **ctx
     )
     if rule is None:
         return
@@ -235,6 +251,8 @@ def fire(site: str, key=None, **ctx) -> None:
     )
     if kind == "compile_failure":
         raise CompileFault(message)
+    if kind == "disconnect":
+        raise DisconnectFault(message)
     raise FaultInjected(message)
 
 

@@ -29,6 +29,10 @@ service/cache.py::validate_record):
   replica pool also carry `replica_id` (which device group served
   the execution — the aggregate's per-replica occupancy) and the
   full `request` payload (what ledger-driven warm start replays);
+  rows written by a fabric worker process (service/fabric/) also
+  carry `worker_id`, so one shared ledger shards by worker — the
+  aggregate's `workers` rollup, with tools/check_ledger.py --stats
+  validating rows land on their fingerprint's ring assignment;
 - kind "drift" (runtime/obs/drift.py): the sampled-vs-exact MRC error
   metrics (`max_abs_delta` / `mean_abs_delta`) and the `breach` flag;
 - kind "bench" (bench.py): the headline `metric`/`value` plus the same
@@ -173,6 +177,12 @@ def validate_row(row) -> list[str]:
         # reads — optional, solo/poolless rows simply omit them
         if "replica_id" in row:
             need_num("replica_id", nullable=True)
+        # fabric context: which worker process of a multi-process
+        # fabric appended this row (service/fabric/) — optional,
+        # single-process rows omit it. tools/check_ledger.py --stats
+        # additionally validates rows shard by ring assignment
+        if "worker_id" in row:
+            need_num("worker_id", nullable=True)
         if "request" in row and not isinstance(row["request"], dict):
             errors.append("'request' must be an object")
         # ir-preflight verdict (service/api.py static-analysis gate):
@@ -429,6 +439,10 @@ def aggregate(rows: list[dict]) -> dict:
     # ledger face of the executor's `replicas` snapshot and the
     # requests_routed_r* counters
     replicas: dict = {}
+    # per-fabric-worker rollup: a shared ledger written by N worker
+    # processes (service/fabric/) shards by worker_id; this is the
+    # offline face of the router's per-link dispatch counters
+    workers: dict = {}
     for row in rows:
         kind = row["kind"]
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -467,6 +481,23 @@ def aggregate(rows: list[dict]) -> dict:
                     r["ok"] += 1
                 if row.get("degraded"):
                     r["degraded"] += 1
+            wid = row.get("worker_id")
+            if wid is not None:
+                w = workers.setdefault(int(wid), {
+                    "rows": 0, "ok": 0, "degraded": 0,
+                    "latencies": [],
+                    "cache": {"mem": 0, "disk": 0, "miss": 0,
+                              "direct": 0},
+                })
+                w["rows"] += 1
+                if row["ok"]:
+                    w["ok"] += 1
+                if row.get("degraded"):
+                    w["degraded"] += 1
+                if row.get("latency_s") is not None:
+                    w["latencies"].append(float(row["latency_s"]))
+                wtier = row.get("cache")
+                w["cache"][wtier if wtier else "direct"] += 1
             bid = row.get("batch_id")
             if bid is not None:
                 b = batches.setdefault(bid, {"rows": 0, "members": 0})
@@ -533,6 +564,15 @@ def aggregate(rows: list[dict]) -> dict:
         agg["p95_unattributed_fraction"] = (
             round(_percentile(unatt, 0.95), 4) if unatt else None
         )
+    for w in workers.values():
+        wl = sorted(w.pop("latencies"))
+        w["p50_latency_s"] = round(_percentile(wl, 0.50), 6)
+        w["p95_latency_s"] = round(_percentile(wl, 0.95), 6)
+        wwarm = w["cache"]["mem"] + w["cache"]["disk"]
+        wserved = wwarm + w["cache"]["miss"]
+        w["cache_hit_rate"] = (
+            round(wwarm / wserved, 3) if wserved else None
+        )
     occupancy = sorted(
         max(b["rows"], b["members"]) for b in batches.values()
     )
@@ -559,6 +599,7 @@ def aggregate(rows: list[dict]) -> dict:
         "batching": batching,
         "service": service,
         "replicas": replicas,
+        "workers": workers,
     }
 
 
@@ -641,6 +682,22 @@ def format_stats(agg: dict) -> list[str]:
         )
         lines.append(
             "replicas: %d active, executions %s" % (len(reps), parts)
+        )
+    fws = agg.get("workers")
+    if fws:
+        parts = ", ".join(
+            "w%d=%d p50=%.4fs p95=%.4fs hit%%=%s%s" % (
+                wid, w["rows"], w["p50_latency_s"],
+                w["p95_latency_s"],
+                ("%.0f" % (w["cache_hit_rate"] * 100))
+                if w["cache_hit_rate"] is not None else "-",
+                (" (degraded %d)" % w["degraded"])
+                if w["degraded"] else "",
+            )
+            for wid, w in sorted(fws.items())
+        )
+        lines.append(
+            "workers: %d fabric worker(s), %s" % (len(fws), parts)
         )
     svc = agg.get("service")
     if svc and svc["submitted"]:

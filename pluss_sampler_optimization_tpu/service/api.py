@@ -382,7 +382,8 @@ class AnalysisService:
                  batch_max_refs: int = 64,
                  replicas=None,
                  preflight: bool = True,
-                 resilience=None):
+                 resilience=None,
+                 worker_id: int | None = None):
         from ..config import BatchConfig
 
         self.cache = ResultCache(cache_dir, mem_entries=mem_entries)
@@ -413,6 +414,10 @@ class AnalysisService:
             # no retries, no hedging, no admission limit — the
             # pre-resilience behavior, bit for bit)
             resilience=resilience,
+            # fabric attribution: set when this service is one worker
+            # of a multi-process fabric (cli serve-worker); ledger
+            # rows carry it so a shared ledger shards by worker
+            worker_id=worker_id,
         )
 
     def begin_shutdown(self) -> None:

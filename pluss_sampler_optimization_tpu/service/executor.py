@@ -454,11 +454,17 @@ class RequestExecutor:
                  batching: BatchConfig | None = None,
                  batch_runner=default_batch_runner,
                  replicas: ReplicaConfig | int | None = None,
-                 resilience: ResilienceConfig | None = None):
+                 resilience: ResilienceConfig | None = None,
+                 worker_id: int | None = None):
         self.cache = cache if cache is not None else ResultCache()
         self.runner = runner
         self.batch_runner = batch_runner
         self.ledger_path = ledger_path
+        # fabric attribution: when this executor is one worker of a
+        # multi-process fabric, every ledger row it appends carries the
+        # worker id, so a shared ledger shards cleanly by the router's
+        # ring assignment (tools/check_ledger.py --stats validates it)
+        self.worker_id = worker_id
         self._resilience = (
             resilience if resilience is not None else ResilienceConfig()
         )
@@ -1438,6 +1444,8 @@ class RequestExecutor:
         row["span_id"] = outcome.get("span_id")
         if outcome.get("replica_id") is not None:
             row["replica_id"] = outcome["replica_id"]
+        if self.worker_id is not None:
+            row["worker_id"] = self.worker_id
         # the full request payload makes the ledger replayable: warm
         # start (--warmup-from-ledger) rebuilds the row's program/
         # machine/sampler config from it to pre-compile the kernels a

@@ -1,0 +1,683 @@
+"""Fabric router: fingerprint-sharded dispatch over N engine workers.
+
+The router is the fabric's thin control plane: it accepts the
+EXISTING serve JSONL protocol — from a file/stdin batch (exactly like
+`serve` mode) and from TCP clients speaking plain JSONL lines — and
+forwards each request line, RAW, to one worker chosen by consistent-
+hashing the request's service fingerprint (service/fingerprint.py)
+onto the worker ring (service/fabric/ring.py). Raw-line forwarding is
+the bit-identity lever: the worker parses/validates/fingerprints the
+same bytes serve_jsonl would, so the fabric can never change what a
+request means — only where it runs. The router executes no engine
+work and never initializes a device backend; it parses lines only to
+compute the routing fingerprint (jax-free code: models + frontend +
+service/fingerprint.py).
+
+Routing rules, in order:
+- oversize lines (> api.MAX_REQUEST_LINE_BYTES) are refused AT the
+  router with serve_jsonl's exact error + best-effort id echo (the
+  payload never travels);
+- `healthz`/`stats` control lines answer ROUTER-locally with the
+  fabric view (link states, dispatch counters); `metrics`/
+  `dump_debug` (and unknown types, and malformed lines) forward by
+  content digest — the owning worker produces the identical
+  structured response/error serve_jsonl would;
+- everything else routes by its service fingerprint, computed here
+  exactly as the worker will compute it (memoized per canonical
+  payload), falling back to the line's content digest when the line
+  cannot be parsed/built.
+
+Failure semantics: each worker link runs per-connection heartbeats
+(ping/pong every FabricConfig.hb_interval_s; silence past
+hb_timeout_s fails the link) and a BOUNDED reconnect schedule. A
+reconnect re-sends that link's in-flight frames (the worker's
+re-submission coalesces or cache-hits bit-identically). Exhausted
+reconnects declare the worker DEAD: its in-flight requests re-dispatch
+to each fingerprint's ring successor among the survivors — EXACTLY
+once per hop, recorded in the response's degrade chain as
+{"from": "worker:K", "to": "worker:J", "reason":
+"worker_disconnect"}, the same shape replica re-routes use. Entry
+ownership makes resolution exactly-once: a response is accepted only
+from a seq's current owner, so a zombie link's late answer is dropped.
+
+Chaos: every request-frame send fires the `worker_conn` site —
+latency/hang delay the send; raise/disconnect sever that link
+(bounded reconnect, then re-dispatch), which is the seeded partition
+scenario tools/check_chaos.py pins.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import time
+
+from ...runtime import faults
+from .. import api
+from ..fingerprint import content_digest
+from . import wire
+from .ring import HashRing
+
+
+def _id_echo(line: str) -> str | None:
+    """serve_jsonl's best-effort id echo for refused lines."""
+    m = re.search(r'"id"\s*:\s*"([^"\\]{1,120})"', line[:4096])
+    return m.group(1) if m else None
+
+
+class Entry:
+    """One routed request line: resolved exactly once."""
+
+    __slots__ = ("seq", "line", "line_no", "req_id", "fp", "owner",
+                 "hops", "degrade", "doc", "_event", "_callback",
+                 "_lock")
+
+    def __init__(self, seq: int, line: str, line_no: int):
+        self.seq = seq
+        self.line = line
+        self.line_no = line_no
+        self.req_id: str | None = None
+        self.fp: str | None = None
+        self.owner: int | None = None
+        self.hops = 0
+        self.degrade: list = []
+        self.doc: dict | None = None
+        self._event = threading.Event()
+        self._callback = None
+        self._lock = threading.Lock()
+
+    def on_done(self, fn) -> None:
+        """Run fn(doc) at resolution (immediately if already done)."""
+        with self._lock:
+            if self.doc is None:
+                self._callback = fn
+                return
+        fn(self.doc)
+
+    def wait(self, timeout: float | None = None) -> dict | None:
+        self._event.wait(timeout)
+        return self.doc
+
+    @property
+    def resolved(self) -> bool:
+        return self.doc is not None
+
+
+class WorkerLink:
+    """One router->worker connection with heartbeats and bounded
+    reconnect. Owns the in-flight entries routed to its worker."""
+
+    def __init__(self, router: "Router", index: int,
+                 host: str, port: int):
+        self.router = router
+        self.index = index
+        self.worker_id = index  # refined by the worker's hello
+        self.host = host
+        self.port = port
+        self.state = "connecting"  # connecting | up | dead
+        self.inflight: dict[int, Entry] = {}
+        self.dispatched = 0
+        self.reconnects = 0
+        self._conn: wire.Conn | None = None
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._bye = threading.Event()
+        self._up_once = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"pluss-fabric-link-{index}",
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def wait_up(self, timeout: float | None = None) -> bool:
+        self._up_once.wait(timeout)
+        return self.state == "up"
+
+    # -- dispatch ------------------------------------------------------
+
+    def dispatch(self, entry: Entry) -> None:
+        """Adopt the entry (it survives reconnects in `inflight`) and
+        push its frame if the link is up — a down link sends it on
+        reconnect, a dying one hands it to re-dispatch."""
+        with self._lock:
+            self.inflight[entry.seq] = entry
+            entry.owner = self.worker_id
+            self.dispatched += 1
+        if self.state == "up":
+            self._send_request(entry)
+
+    def _send_request(self, entry: Entry) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        try:
+            faults.fire("worker_conn", key=entry.seq,
+                        worker_id=self.worker_id)
+            conn.send({"type": "request", "seq": entry.seq,
+                       "line": entry.line, "line_no": entry.line_no})
+        except wire.FrameTooLarge:
+            # this entry can never travel: answer it, don't kill the
+            # link (pop first so re-dispatch cannot double-answer)
+            with self._lock:
+                self.inflight.pop(entry.seq, None)
+            self.router._resolve(entry, {
+                "id": entry.req_id or _id_echo(entry.line),
+                "ok": False, "line": entry.line_no,
+                "error": "request line does not fit a fabric frame",
+            })
+        except (faults.FaultInjected, wire.WireError, OSError):
+            # injected or real send failure: sever the link — the
+            # reader notices, reconnect re-sends everything in flight
+            conn.close()
+
+    # -- connection lifecycle ------------------------------------------
+
+    def _run(self) -> None:
+        fabric = self.router.fabric
+        attempts = 0
+        while not self._closed.is_set():
+            conn = None
+            try:
+                conn = wire.connect(
+                    self.host, self.port,
+                    timeout=fabric.connect_timeout_s,
+                )
+                conn.send({"type": "hello",
+                           "wire_version": wire.WIRE_VERSION,
+                           "role": "router"})
+                hello = conn.recv(timeout=fabric.connect_timeout_s)
+                if hello is None or hello.get("type") != "hello":
+                    raise wire.WireError(
+                        "handshake refused: "
+                        + str((hello or {}).get("error")
+                              or "no hello reply")
+                    )
+                wid = hello.get("worker_id")
+                if isinstance(wid, int):
+                    self.worker_id = wid
+                self._conn = conn
+                self.state = "up"
+                attempts = 0
+                self._up_once.set()
+                # re-send everything still in flight: the responses
+                # lost with the old socket re-materialize from the
+                # worker's cache/singleflight, bit-identical
+                with self._lock:
+                    pending = list(self.inflight.values())
+                for entry in pending:
+                    self._send_request(entry)
+                self._read_loop(conn)
+                return  # clean exit (bye/close)
+            except (wire.WireError, OSError, socket.timeout):
+                pass
+            finally:
+                if conn is not None and self._conn is conn:
+                    self._conn = None
+                if conn is not None:
+                    conn.close()
+            if self._closed.is_set():
+                return
+            self.state = "connecting"
+            attempts += 1
+            self.reconnects += 1
+            if attempts > fabric.reconnect_attempts:
+                self.state = "dead"
+                self.router._on_link_dead(self)
+                return
+            time.sleep(fabric.reconnect_delay_s)
+
+    def _read_loop(self, conn: wire.Conn) -> None:
+        fabric = self.router.fabric
+        while not self._closed.is_set():
+            frame = conn.recv(timeout=fabric.hb_timeout_s)
+            if frame is None:
+                raise wire.ConnectionClosed("worker closed the link")
+            kind = frame.get("type")
+            if kind == "response":
+                self.router._on_response(self, frame)
+            elif kind == "bye":
+                self._bye.set()
+                return
+            # pong/error frames are just liveness traffic
+
+    def ping(self) -> None:
+        conn = self._conn
+        if self.state == "up" and conn is not None:
+            try:
+                conn.send({"type": "ping", "t": time.time()})
+            except (wire.WireError, OSError):
+                conn.close()
+
+    def drain_inflight(self) -> list[Entry]:
+        with self._lock:
+            entries = list(self.inflight.values())
+            self.inflight.clear()
+        return entries
+
+    def take(self, seq: int) -> Entry | None:
+        with self._lock:
+            return self.inflight.pop(seq, None)
+
+    def shutdown(self, timeout: float) -> bool:
+        """Graceful: ask the worker to drain, wait for its bye."""
+        conn = self._conn
+        if conn is not None and self.state == "up":
+            try:
+                conn.send({"type": "shutdown"})
+            except (wire.WireError, OSError):
+                pass
+            self._bye.wait(timeout)
+        self.close()
+        return self._bye.is_set()
+
+    def close(self) -> None:
+        self._closed.set()
+        conn = self._conn
+        if conn is not None:
+            conn.close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+
+class Router:
+    """The fabric's dispatch plane over a set of worker addresses."""
+
+    def __init__(self, worker_addrs, fabric=None):
+        from ...config import FabricConfig
+
+        if not worker_addrs:
+            raise ValueError("router needs at least one worker "
+                             "address")
+        self.fabric = fabric if fabric is not None else FabricConfig()
+        self.links = [
+            WorkerLink(self, i, host, port)
+            for i, (host, port) in enumerate(worker_addrs)
+        ]
+        self._ring: HashRing | None = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._fp_memo: dict[str, str] = {}
+        self._draining = False
+        self._listener: socket.socket | None = None
+        self._client_threads: list[threading.Thread] = []
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.counters = {
+            "lines": 0, "routed": 0, "local": 0, "redispatched": 0,
+            "responses": 0, "dropped_stale": 0, "no_worker": 0,
+            "tcp_clients": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, wait_up: bool = True) -> "Router":
+        """Connect every link (handshakes resolve worker ids), build
+        the ring over the REPORTED ids — a pure function of the id
+        set, so assignment is stable across router restarts — and
+        start the heartbeat ticker."""
+        for link in self.links:
+            link.start()
+        if wait_up:
+            deadline = time.time() + self.fabric.connect_timeout_s
+            for link in self.links:
+                link.wait_up(max(0.1, deadline - time.time()))
+        self._ring = HashRing(
+            [link.worker_id for link in self.links],
+            vnodes=self.fabric.ring_vnodes,
+        )
+        self._by_id = {link.worker_id: link for link in self.links}
+        self._ticker = threading.Thread(
+            target=self._heartbeat_loop, name="pluss-fabric-hb",
+            daemon=True,
+        )
+        self._ticker.start()
+        return self
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.fabric.hb_interval_s):
+            for link in self.links:
+                link.ping()
+
+    def alive_ids(self) -> set:
+        return {link.worker_id for link in self.links
+                if link.state != "dead"}
+
+    # -- routing -------------------------------------------------------
+
+    def _routing_fingerprint(self, line: str) -> str:
+        """The worker's service fingerprint for this line — computed
+        HERE with the same parse/build path (jax-free), memoized per
+        canonical payload; content digest for lines a worker will
+        refuse (their errors need determinism, not affinity)."""
+        try:
+            request = api.parse_request_line(line)
+            key = json.dumps(request.payload(), sort_keys=True,
+                             default=str)
+            fp = self._fp_memo.get(key)
+            if fp is None:
+                fp = request.fingerprint()
+                if len(self._fp_memo) >= 4096:
+                    self._fp_memo.clear()
+                self._fp_memo[key] = fp
+            return fp
+        except Exception:
+            return content_digest({"line": line})
+
+    def submit_line(self, line: str, line_no: int = 0) -> Entry:
+        """Route one JSONL line; returns its Entry (resolving to the
+        serve-protocol response dict)."""
+        with self._lock:
+            self._seq += 1
+            entry = Entry(self._seq, line.strip(), line_no)
+        self.counters["lines"] += 1
+        line = entry.line
+        if len(line) > api.MAX_REQUEST_LINE_BYTES:
+            entry.req_id = _id_echo(line)
+            self.counters["local"] += 1
+            self._resolve(entry, {
+                "id": entry.req_id, "ok": False, "line": line_no,
+                "error": (
+                    f"request line of {len(line)} bytes exceeds the "
+                    f"{api.MAX_REQUEST_LINE_BYTES}-byte limit"
+                ),
+            })
+            return entry
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            doc = None
+        if isinstance(doc, dict):
+            entry.req_id = doc.get("id")
+        if isinstance(doc, dict) and doc.get("type") in ("healthz",
+                                                         "stats"):
+            # fabric-local introspection: the router IS the authority
+            # on link/dispatch state; per-process engine introspection
+            # rides metrics/dump_debug lines to a worker instead
+            kind = doc["type"]
+            payload = (self.healthz() if kind == "healthz"
+                       else self.stats())
+            self.counters["local"] += 1
+            self._resolve(entry, {"id": entry.req_id, "ok": True,
+                                  "type": kind, kind: payload})
+            return entry
+        if self._draining:
+            self._resolve(entry, {
+                "id": entry.req_id, "ok": False, "line": line_no,
+                "shed": True,
+                "error": "shed: router shutting down",
+            })
+            return entry
+        entry.fp = self._routing_fingerprint(line)
+        self._route(entry)
+        return entry
+
+    def _route(self, entry: Entry) -> None:
+        try:
+            wid = self._ring.assign(entry.fp, alive=self.alive_ids())
+        except LookupError:
+            self.counters["no_worker"] += 1
+            self._resolve(entry, {
+                "id": entry.req_id, "ok": False,
+                "line": entry.line_no,
+                "error": "no live fabric workers",
+            })
+            return
+        self.counters["routed"] += 1
+        self._by_id[wid].dispatch(entry)
+
+    # -- link events ---------------------------------------------------
+
+    def _on_response(self, link: WorkerLink, frame: dict) -> None:
+        seq = frame.get("seq")
+        doc = frame.get("doc")
+        entry = link.take(seq) if isinstance(seq, int) else None
+        if entry is None or entry.owner != link.worker_id:
+            # a zombie link answering a re-dispatched seq: the current
+            # owner's answer is the one that counts — exactly-once
+            self.counters["dropped_stale"] += 1
+            return
+        if not isinstance(doc, dict):
+            doc = {"id": entry.req_id, "ok": False,
+                   "line": entry.line_no,
+                   "error": "malformed response frame from worker"}
+        if entry.degrade:
+            # the re-dispatch hops this entry survived, ahead of any
+            # engine-level degradation the worker recorded — the same
+            # chain shape replica re-routes use
+            doc = dict(doc)
+            doc["degraded"] = entry.degrade + list(
+                doc.get("degraded") or []
+            )
+        self.counters["responses"] += 1
+        self._resolve(entry, doc)
+
+    def _on_link_dead(self, link: WorkerLink) -> None:
+        """Reconnects exhausted: re-dispatch the dead worker's
+        in-flight entries to each fingerprint's ring successor."""
+        entries = link.drain_inflight()
+        for entry in entries:
+            entry.hops += 1
+            if entry.hops >= len(self.links):
+                self._resolve(entry, {
+                    "id": entry.req_id, "ok": False,
+                    "line": entry.line_no,
+                    "error": ("no live fabric workers after "
+                              f"{entry.hops} re-dispatch(es)"),
+                })
+                continue
+            old = entry.owner
+            alive = self.alive_ids()
+            try:
+                new = self._ring.assign(entry.fp, alive=alive)
+            except LookupError:
+                self.counters["no_worker"] += 1
+                self._resolve(entry, {
+                    "id": entry.req_id, "ok": False,
+                    "line": entry.line_no,
+                    "error": "no live fabric workers",
+                })
+                continue
+            entry.degrade.append({
+                "from": f"worker:{old}", "to": f"worker:{new}",
+                "reason": "worker_disconnect",
+            })
+            self.counters["redispatched"] += 1
+            self._by_id[new].dispatch(entry)
+
+    def _resolve(self, entry: Entry, doc: dict) -> None:
+        with entry._lock:
+            if entry.doc is not None:
+                return
+            entry.doc = doc
+            callback = entry._callback
+            entry._callback = None
+        entry._event.set()
+        if callback is not None:
+            try:
+                callback(doc)
+            except Exception:
+                pass
+
+    # -- introspection -------------------------------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "status": ("ok" if self.alive_ids() else "no_workers"),
+            "role": "router",
+            "workers": {
+                str(link.worker_id): {
+                    "addr": f"{link.host}:{link.port}",
+                    "state": link.state,
+                    "in_flight": len(link.inflight),
+                }
+                for link in self.links
+            },
+            "ring": list(self._ring.worker_ids) if self._ring else [],
+        }
+
+    def stats(self) -> dict:
+        return {
+            "role": "router",
+            "counters": dict(self.counters),
+            "workers": {
+                str(link.worker_id): {
+                    "state": link.state,
+                    "dispatched": link.dispatched,
+                    "in_flight": len(link.inflight),
+                    "reconnects": link.reconnects,
+                }
+                for link in self.links
+            },
+        }
+
+    # -- serving fronts ------------------------------------------------
+
+    def serve_stream(self, fin, fout) -> int:
+        """The serve-mode front: read a JSONL batch, dispatch every
+        line up front (affinity batches per worker; duplicates
+        coalesce ON the owning worker), then emit responses in input
+        order. Returns the failure count, like serve_jsonl. A
+        GracefulShutdown in either pass stops reading and answers
+        everything already dispatched."""
+        entries: list[Entry] = []
+        try:
+            for line_no, line in enumerate(fin, start=1):
+                if not line.strip():
+                    continue
+                entries.append(self.submit_line(line, line_no))
+        except api.GracefulShutdown:
+            self._draining = True
+        failures = 0
+        for entry in entries:
+            while True:
+                try:
+                    doc = entry.wait(
+                        timeout=self.fabric.drain_timeout_s
+                    )
+                    break
+                except api.GracefulShutdown:
+                    self._draining = True
+                    continue
+            if doc is None:
+                doc = {"id": entry.req_id, "ok": False,
+                       "line": entry.line_no,
+                       "error": "fabric response timed out"}
+                self._resolve(entry, doc)
+                doc = entry.doc
+            if not doc.get("ok"):
+                failures += 1
+            fout.write(json.dumps(doc) + "\n")
+            fout.flush()
+        return failures
+
+    def serve_tcp(self, host: str = "127.0.0.1", port: int = 0
+                  ) -> tuple[str, int]:
+        """The TCP front: clients speak plain JSONL lines (loadgen
+        --connect drives this); responses stream back AS READY —
+        clients match them by `id`, since affinity dispatch makes
+        input-order completion meaningless across workers."""
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((host, port))
+        ls.listen(16)
+        self._listener = ls
+        bound = ls.getsockname()[:2]
+        t = threading.Thread(target=self._accept_clients,
+                             name="pluss-fabric-tcp", daemon=True)
+        t.start()
+        return bound
+
+    def _accept_clients(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            self.counters["tcp_clients"] += 1
+            t = threading.Thread(
+                target=self._serve_client, args=(sock,),
+                name="pluss-fabric-client", daemon=True,
+            )
+            t.start()
+            self._client_threads.append(t)
+
+    def _serve_client(self, sock: socket.socket) -> None:
+        wlock = threading.Lock()
+        pending: list[Entry] = []
+        rfile = sock.makefile("r", encoding="utf-8", newline="\n")
+        wfile = sock.makefile("w", encoding="utf-8", newline="\n")
+
+        def _emit(doc: dict) -> None:
+            with wlock:
+                try:
+                    wfile.write(json.dumps(doc) + "\n")
+                    wfile.flush()
+                except (OSError, ValueError):
+                    pass  # client went away; nothing to answer
+
+        try:
+            for line_no, line in enumerate(rfile, start=1):
+                if not line.strip():
+                    continue
+                entry = self.submit_line(line, line_no)
+                pending.append(entry)
+                entry.on_done(_emit)
+            for entry in pending:
+                entry.wait(timeout=self.fabric.drain_timeout_s)
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                rfile.close()
+                wfile.close()
+                sock.close()
+            except OSError:
+                pass
+
+    # -- shutdown ------------------------------------------------------
+
+    def begin_shutdown(self) -> None:
+        """Stop accepting: the TCP listener closes, later lines shed
+        with structured responses; dispatched work keeps draining."""
+        self._draining = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def close(self, graceful: bool = True) -> None:
+        """Tear the fabric's router side down. Graceful: drain
+        in-flight entries, ask every live worker to drain (`shutdown`
+        frame -> `bye`), then close links."""
+        self.begin_shutdown()
+        self._stop.set()
+        if graceful:
+            deadline = time.time() + self.fabric.drain_timeout_s
+            for link in self.links:
+                with link._lock:
+                    snapshot = list(link.inflight.values())
+                for entry in snapshot:
+                    entry.wait(timeout=max(0.1,
+                                           deadline - time.time()))
+            for link in self.links:
+                link.shutdown(timeout=max(
+                    0.1, deadline - time.time()
+                ))
+        for link in self.links:
+            link.close()
+        # anything still unresolved (dead workers mid-drain) answers
+        # as an error so no caller blocks forever
+        for link in self.links:
+            for entry in link.drain_inflight():
+                self._resolve(entry, {
+                    "id": entry.req_id, "ok": False,
+                    "line": entry.line_no,
+                    "error": "router closed before a worker answered",
+                })
+        if self._ticker is not None and self._ticker.is_alive():
+            self._ticker.join(timeout=2.0)

@@ -1,0 +1,456 @@
+"""Multi-process serving fabric (ISSUE 18): wire framing, the
+consistent-hash ring, the router/worker pair, and the CLI supervisor.
+
+The acceptance invariants pinned here:
+
+- BIT-IDENTITY: the same mixed request set (solo fingerprints, a
+  byte-different duplicate, an inline custom program) served by
+  serve_jsonl directly, by a 1-worker fabric, and by a 3-worker
+  fabric yields identical (ok, fingerprint, mrc_digest, engine_used)
+  per id — cold cache and warm cache, batched stream and
+  one-at-a-time solo submits. Sharding is invisible in the bytes.
+- The wire layer enforces the frame cap BEFORE materializing hostile
+  payloads, distinguishes clean EOF from mid-frame EOF, and refuses
+  malformed frames with typed errors.
+- The ring is a pure function of the worker-id set: restart-stable,
+  order-independent, minimal movement on membership change, and
+  dead-worker failover follows the preference order.
+- Router edges: an oversized line is refused AT the router with the
+  serve protocol's 1 MiB budget and best-effort id echo; a malformed
+  line still produces exactly one structured error response; a
+  handshake version mismatch is a structured `error` frame.
+- A real `serve-router --workers 2` fabric under SIGTERM drains:
+  exit 0, responses answered, and a final flight-recorder bundle per
+  process (router + each worker).
+- tools/check_fabric.py (subprocess supervisor, 1-vs-2-worker digest
+  identity, restart-stable sharding, worker-kill re-dispatch, zero
+  orphans) passes from tier-1.
+"""
+
+import glob
+import io
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pluss_sampler_optimization_tpu.config import FabricConfig
+from pluss_sampler_optimization_tpu.frontend import program_to_json
+from pluss_sampler_optimization_tpu.models import build
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisService,
+    serve_jsonl,
+)
+from pluss_sampler_optimization_tpu.service.fabric import (
+    HashRing,
+    Router,
+    WorkerServer,
+    wire,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+TIMEOUT_S = 300.0
+
+# test-speed fabric: fast heartbeats, quick bounded reconnect
+_CFG = FabricConfig(hb_interval_s=0.2, hb_timeout_s=5.0,
+                    reconnect_attempts=2, reconnect_delay_s=0.1,
+                    connect_timeout_s=10.0, drain_timeout_s=60.0)
+
+
+# -- wire framing ------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return wire.Conn(a), wire.Conn(b)
+
+
+def test_wire_roundtrip_and_eof_semantics():
+    ca, cb = _pair()
+    ca.send({"type": "ping", "t": 7})
+    ca.send({"type": "request", "seq": 1, "line": "x" * 2048})
+    assert cb.recv(timeout=5) == {"type": "ping", "t": 7}
+    assert cb.recv(timeout=5)["seq"] == 1
+    # clean EOF between frames is None, not an exception
+    ca.close()
+    assert cb.recv(timeout=5) is None
+    cb.close()
+
+
+def test_wire_refuses_oversized_and_malformed_frames():
+    ca, cb = _pair()
+    with pytest.raises(wire.FrameTooLarge):
+        ca.send({"pad": "x" * (wire.MAX_FRAME_BYTES + 16)})
+    # an announced length over the cap is refused BEFORE the body is
+    # read — the receiver never allocates for it
+    ca._sock.sendall(struct.pack(">I", wire.MAX_FRAME_BYTES + 1))
+    with pytest.raises(wire.FrameTooLarge):
+        cb.recv(timeout=5)
+    ca.close()
+    cb.close()
+
+    ca, cb = _pair()
+    ca._sock.sendall(struct.pack(">I", 8) + b"not-json")
+    with pytest.raises(wire.WireError):
+        cb.recv(timeout=5)
+    ca.close()
+    cb.close()
+
+    # EOF inside a frame is a ConnectionClosed, not a silent None
+    ca, cb = _pair()
+    ca._sock.sendall(struct.pack(">I", 64) + b"partial")
+    ca.close()
+    with pytest.raises(wire.ConnectionClosed):
+        cb.recv(timeout=5)
+    cb.close()
+
+
+def test_parse_hostport():
+    assert wire.parse_hostport("10.0.0.2:80") == ("10.0.0.2", 80)
+    assert wire.parse_hostport(":9100") == ("127.0.0.1", 9100)
+    for bad in ("nope", "host:", "host:abc"):
+        with pytest.raises(ValueError):
+            wire.parse_hostport(bad)
+
+
+# -- the consistent-hash ring ------------------------------------------
+
+
+def test_ring_pure_function_of_id_set():
+    fps = [f"fp-{i:04d}" for i in range(256)]
+    r = HashRing([0, 1, 2])
+    again = HashRing((2, 0, 1))  # order/type must not matter
+    assert [r.assign(f) for f in fps] == [again.assign(f) for f in fps]
+    # all workers actually used, preference lists are distinct ids
+    owners = {r.assign(f) for f in fps}
+    assert owners == {0, 1, 2}
+    pref = r.preference(fps[0])
+    assert sorted(pref) == [0, 1, 2]
+    assert pref[0] == r.assign(fps[0])
+
+
+def test_ring_minimal_movement_and_failover():
+    fps = [f"fp-{i:04d}" for i in range(256)]
+    r3 = HashRing([0, 1, 2])
+    r2 = HashRing([0, 2])
+    for f in fps:
+        primary = r3.assign(f)
+        if primary != 1:
+            # fingerprints not on the removed worker must not move
+            assert r2.assign(f) == primary
+        # dead-worker failover equals the shrunken ring's assignment
+        assert r3.assign(f, alive={0, 2}) == r2.assign(f)
+    with pytest.raises(LookupError):
+        r3.assign(fps[0], alive=set())
+
+
+# -- in-process fabric helpers -----------------------------------------
+
+
+def _mixed_lines() -> list[str]:
+    """3 solo fingerprints + a byte-different duplicate of fb-0 + an
+    inline custom program that is fb-0's structural twin."""
+    base = {"model": "gemm", "n": 16, "engine": "sampled",
+            "ratio": 0.2}
+    lines = [
+        json.dumps({**base, "seed": 7100 + k, "threads": 2 + (k % 3),
+                    "id": f"fb-{k}"})
+        for k in range(3)
+    ]
+    lines.append(json.dumps({**base, "seed": 7100, "threads": 2,
+                             "id": "fb-dup"}))
+    lines.append(json.dumps({
+        "id": "fb-custom",
+        "program": program_to_json(build("gemm", 16)),
+        "engine": "sampled", "ratio": 0.2, "seed": 7100, "threads": 2,
+    }))
+    return lines
+
+
+def _run_fabric(n_workers: int, cache_dir, lines,
+                solo: bool = False) -> dict:
+    """Serve `lines` through an in-process router over n real worker
+    stacks; returns {id: response doc}. solo=True submits one line at
+    a time (each awaited before the next), the anti-batch."""
+    services = [
+        AnalysisService(cache_dir=str(cache_dir), max_workers=2)
+        for _ in range(n_workers)
+    ]
+    workers = []
+    try:
+        for i, svc in enumerate(services):
+            ws = WorkerServer(svc, worker_id=i, fabric=_CFG)
+            ws.start()
+            workers.append(ws)
+        router = Router([ws.address for ws in workers], _CFG)
+        router.start()
+        try:
+            if solo:
+                docs = []
+                for no, ln in enumerate(lines, start=1):
+                    entry = router.submit_line(ln, no)
+                    doc = entry.wait(timeout=TIMEOUT_S)
+                    assert doc is not None
+                    docs.append(doc)
+            else:
+                fout = io.StringIO()
+                router.serve_stream(
+                    io.StringIO("\n".join(lines) + "\n"), fout
+                )
+                docs = [json.loads(ln)
+                        for ln in fout.getvalue().splitlines()]
+        finally:
+            router.close(graceful=True)
+    finally:
+        for ws in workers:
+            ws.close()
+        for svc in services:
+            svc.close()
+    assert len(docs) == len(lines)
+    return {d["id"]: d for d in docs}
+
+
+def _sig(doc: dict) -> tuple:
+    return (doc.get("ok"), doc.get("fingerprint"),
+            doc.get("mrc_digest"), doc.get("engine_used"))
+
+
+# -- the tentpole invariant --------------------------------------------
+
+
+def test_bit_identity_1_vs_3_workers_cold_warm_solo_batched(tmp_path):
+    """Same bytes no matter the topology: serve_jsonl directly vs a
+    1-worker fabric vs a 3-worker fabric, cold and warm, batched
+    stream and solo submits — identical (ok, fingerprint, mrc_digest,
+    engine_used) per id, and the duplicate/custom twins coalesce onto
+    fb-0's fingerprint through the fabric exactly as in-process."""
+    lines = _mixed_lines()
+    with AnalysisService(cache_dir=str(tmp_path / "direct"),
+                         max_workers=2) as svc:
+        fout = io.StringIO()
+        serve_jsonl(svc, io.StringIO("\n".join(lines) + "\n"), fout)
+    direct = {d["id"]: d for d in
+              (json.loads(ln) for ln in fout.getvalue().splitlines())}
+    assert all(d["ok"] for d in direct.values())
+    want = {i: _sig(d) for i, d in direct.items()}
+    # the twins really are twins — the fabric must keep them together
+    assert direct["fb-dup"]["fingerprint"] \
+        == direct["fb-custom"]["fingerprint"] \
+        == direct["fb-0"]["fingerprint"]
+
+    one = _run_fabric(1, tmp_path / "f1", lines)
+    three = _run_fabric(3, tmp_path / "f3", lines)
+    warm_batched = _run_fabric(3, tmp_path / "f3", lines)
+    warm_solo = _run_fabric(3, tmp_path / "f3", lines, solo=True)
+
+    for tag, docs in (("1w-cold", one), ("3w-cold", three),
+                      ("3w-warm", warm_batched),
+                      ("3w-warm-solo", warm_solo)):
+        assert {i: _sig(d) for i, d in docs.items()} == want, tag
+        assert all("worker_id" in d for d in docs.values()), tag
+    # warm runs on the shared disk tier: fresh processes, zero misses
+    for docs in (warm_batched, warm_solo):
+        assert all(d["cache"] != "miss" for d in docs.values())
+    # 3 workers: affinity keeps equal fingerprints on one worker
+    by_fp = {}
+    for d in three.values():
+        by_fp.setdefault(d["fingerprint"], set()).add(d["worker_id"])
+    assert all(len(ws) == 1 for ws in by_fp.values())
+
+
+# -- router edge cases -------------------------------------------------
+
+
+def test_router_oversized_and_malformed_lines(tmp_path):
+    from pluss_sampler_optimization_tpu.service import api
+
+    with AnalysisService(cache_dir=str(tmp_path / "c"),
+                         max_workers=2) as svc:
+        ws = WorkerServer(svc, worker_id=0, fabric=_CFG)
+        ws.start()
+        router = Router([ws.address], _CFG)
+        router.start()
+        try:
+            # oversized: refused AT the router, id echoed, never sent
+            big = ('{"id": "big-id", "model": "gemm", "pad": "'
+                   + "x" * (api.MAX_REQUEST_LINE_BYTES + 64) + '"}')
+            doc = router.submit_line(big, 1).wait(timeout=30)
+            assert doc is not None and not doc["ok"]
+            assert doc["id"] == "big-id"
+            assert str(api.MAX_REQUEST_LINE_BYTES) in doc["error"]
+            assert router.counters["routed"] == 0
+
+            # malformed JSON: routed by content digest, answered with
+            # exactly one structured error (id stays None — the
+            # serve_jsonl contract for unparseable lines, mirrored
+            # byte-for-byte by the worker)
+            doc = router.submit_line(
+                '{"id": "mal", "model": ', 2
+            ).wait(timeout=60)
+            assert doc is not None and not doc["ok"]
+            assert doc["id"] is None
+            assert "invalid JSON" in doc["error"]
+
+            # unknown request field: the worker's serve path answers
+            doc = router.submit_line(
+                '{"id": "uf", "model": "gemm", "bogus": 1}', 3
+            ).wait(timeout=60)
+            assert doc is not None and not doc["ok"]
+            assert doc["id"] == "uf" and "bogus" in doc["error"]
+        finally:
+            router.close(graceful=True)
+            ws.close()
+
+
+def test_worker_rejects_handshake_version_mismatch(tmp_path):
+    with AnalysisService(cache_dir=None, max_workers=1) as svc:
+        ws = WorkerServer(svc, worker_id=0, fabric=_CFG)
+        host, port = ws.start()
+        try:
+            conn = wire.connect(host, port, timeout=5)
+            conn.send({"type": "hello", "wire_version": 99})
+            reply = conn.recv(timeout=10)
+            assert reply["type"] == "error"
+            assert "wire version mismatch" in reply["error"]
+            assert reply["wire_version"] == wire.WIRE_VERSION
+            # and the connection is closed — no half-agreed protocol
+            try:
+                assert conn.recv(timeout=10) is None
+            except wire.ConnectionClosed:
+                pass
+            conn.close()
+            assert ws.stats_counters["handshake_rejected"] == 1
+        finally:
+            ws.close()
+
+
+# -- TCP front + loadgen -----------------------------------------------
+
+
+def test_tcp_front_loadgen_connect_and_hostile_lines(tmp_path):
+    """The router's JSONL TCP front: loadgen --connect machinery gets
+    every response back bit-matched by id, and hostile client lines
+    (malformed, oversized) are answered in-stream without killing the
+    connection."""
+    import loadgen
+
+    with AnalysisService(cache_dir=str(tmp_path / "c"),
+                         max_workers=2) as svc:
+        ws = WorkerServer(svc, worker_id=0, fabric=_CFG)
+        ws.start()
+        router = Router([ws.address], _CFG)
+        router.start()
+        host, port = router.serve_tcp("127.0.0.1", 0)
+        try:
+            reqs = loadgen.make_requests(3, seed=5)
+            offs = loadgen.arrival_offsets(3, 200.0, seed=5)
+            report = loadgen.connect_run(f"{host}:{port}", reqs, offs,
+                                         timeout_s=TIMEOUT_S)
+            assert report["submitted"] == 3 and report["ok"] == 3
+            assert report["failed"] == 0 and report["missing"] == 0
+            assert report["latency_p95_s"] is not None
+
+            sock = socket.create_connection((host, port), timeout=10)
+            rf = sock.makefile("r", encoding="utf-8")
+            wf = sock.makefile("w", encoding="utf-8")
+            wf.write('{"id": "bad-json", "model": \n')
+            wf.write(json.dumps(
+                {"id": "hz", "type": "healthz"}) + "\n")
+            wf.flush()
+            got = [json.loads(rf.readline()) for _ in range(2)]
+            # the malformed line answers with id None (the serve
+            # protocol's unparseable-line contract), in-stream
+            bad = [d for d in got if not d.get("ok")]
+            assert len(bad) == 1 and bad[0]["id"] is None
+            assert "invalid JSON" in bad[0]["error"]
+            hz = [d for d in got if d.get("id") == "hz"][0]
+            assert hz["ok"] and hz["healthz"]["role"] == "router"
+            sock.close()
+        finally:
+            router.close(graceful=True)
+            ws.close()
+
+
+# -- whole-fabric SIGTERM drain (subprocess) ---------------------------
+
+
+def test_fabric_sigterm_drain_subprocess(tmp_path):
+    """A real supervisor fabric under SIGTERM: the router stops
+    accepting, the workers drain, every process writes its final
+    flight-recorder bundle, and the tree exits 0 with no orphans."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    bundles = str(tmp_path / "bundles")
+    err_path = str(tmp_path / "router.err")
+    cmd = [
+        sys.executable, "-m", "pluss_sampler_optimization_tpu.cli",
+        "serve-router", "--workers", "2", "--listen", "127.0.0.1:0",
+        "--cache-dir", str(tmp_path / "store"),
+        "--ledger", str(tmp_path / "ledger.jsonl"),
+        "--debug-bundle-dir", bundles,
+        "--compilation-cache-dir",
+        os.path.join(REPO_ROOT, ".jax_cache", "tests"),
+    ]
+    with open(err_path, "w") as errf:
+        proc = subprocess.Popen(cmd, cwd=REPO_ROOT, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=errf, text=True)
+    try:
+        addr = None
+        deadline = time.time() + TIMEOUT_S
+        while time.time() < deadline:
+            text = open(err_path).read()
+            if "JSONL TCP front on " in text:
+                spec = text.split("JSONL TCP front on ", 1)[1]
+                addr = wire.parse_hostport(spec.splitlines()[0])
+                break
+            assert proc.poll() is None, f"router died: {text[-800:]}"
+            time.sleep(0.25)
+        assert addr is not None, "fabric never opened its TCP front"
+
+        sock = socket.create_connection(addr, timeout=30)
+        rf = sock.makefile("r", encoding="utf-8")
+        wf = sock.makefile("w", encoding="utf-8")
+        wf.write(json.dumps({"id": "st-1", "model": "gemm", "n": 16,
+                             "engine": "oracle"}) + "\n")
+        wf.flush()
+        doc = json.loads(rf.readline())
+        assert doc["id"] == "st-1" and doc["ok"]
+        sock.close()
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    err = open(err_path).read()
+    assert "graceful shutdown" in err
+    # one final flight-recorder bundle per PROCESS: the router's in
+    # the root bundle dir, each worker's under worker{i}/
+    assert glob.glob(os.path.join(bundles, "BUNDLE_*_shutdown.json"))
+    for wid in (0, 1):
+        got = glob.glob(os.path.join(bundles, f"worker{wid}",
+                                     "BUNDLE_*_shutdown.json"))
+        assert got, f"worker {wid} wrote no shutdown bundle " \
+            f"({err[-500:]})"
+
+
+# -- the subprocess CI gate --------------------------------------------
+
+
+def test_check_fabric_gate():
+    """The full tools/check_fabric.py gate: supervisor subprocesses,
+    1-vs-2-worker digest identity cold+warm, restart-stable sharding,
+    the SIGKILL re-dispatch path, zero orphans."""
+    import check_fabric
+
+    assert check_fabric.main([]) == 0

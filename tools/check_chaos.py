@@ -24,6 +24,13 @@ the resilience layer exists to provide:
                  second replica; the winner's result is the result
   shedding       under pinned overload, admission control holds p95
                  while the shed-disabled baseline's p95 collapses
+  fabric         a 3-worker serving fabric (service/fabric/) under a
+                 worker_conn partition blip and a hard worker kill
+                 mid-load: every submitted line reaches exactly one
+                 terminal outcome, re-dispatched requests record the
+                 worker_disconnect hop in their degrade chain, and
+                 every ok response is bit-identical to the
+                 single-process baseline
 
 Phases run per seed (--seeds N => seeds 0..N-1); any violated
 property is reported and fails the gate. The heavier overload soak
@@ -421,6 +428,174 @@ def check_serve_line_faults(seed: int, problems: list) -> None:
                         "failed")
 
 
+def _fabric_run(seed: int, lines: list[str], cache_dir: str,
+                kill_after: int = 0,
+                service_time_s: float = 0.2) -> dict:
+    """One in-process 3-worker fabric pass over `lines`. With
+    kill_after=k, the worker holding the most in-flight work is
+    severed (WorkerServer.close — no drain, the abrupt chaos kill)
+    right after the k-th submission, while later lines keep arriving.
+    Returns docs in submit order plus the router's counters."""
+    from pluss_sampler_optimization_tpu.config import FabricConfig
+    from pluss_sampler_optimization_tpu.service.fabric import (
+        Router,
+        WorkerServer,
+    )
+
+    fabric = FabricConfig(
+        hb_interval_s=0.2, hb_timeout_s=3.0,
+        reconnect_attempts=2, reconnect_delay_s=0.1,
+        connect_timeout_s=10.0, drain_timeout_s=30.0,
+    )
+    services, workers = [], []
+    docs: list = []
+    stats: dict = {}
+    killed_wid = None
+    try:
+        for wid in range(3):
+            svc = _service(cache_dir, None, seed,
+                           service_time_s=service_time_s)
+            ws = WorkerServer(svc, worker_id=wid, fabric=fabric)
+            ws.start()
+            services.append(svc)
+            workers.append(ws)
+        router = Router([ws.address for ws in workers], fabric)
+        router.start()
+        try:
+            entries = []
+            for i, line in enumerate(lines, start=1):
+                entries.append(router.submit_line(line, i))
+                if kill_after and i == kill_after:
+                    # sever the busiest worker so the kill provably
+                    # strands in-flight work for re-dispatch
+                    victim = max(router.links,
+                                 key=lambda lk: len(lk.inflight))
+                    killed_wid = victim.worker_id
+                    workers[killed_wid].close()
+            docs = [e.wait(timeout=TIMEOUT_S) for e in entries]
+            stats = router.stats()
+        finally:
+            router.close(graceful=True)
+    finally:
+        for ws in workers:
+            ws.close()
+        for svc in services:
+            svc.close()
+    return {"docs": docs, "stats": stats, "killed": killed_wid}
+
+
+def check_fabric_chaos(seed: int, tmp: str, problems: list) -> None:
+    """The fabric phase: single-process baseline digests, then (a) a
+    deterministic worker_conn partition blip (first dispatch severed;
+    the link reconnects and re-sends, nothing is lost) and (b) a hard
+    kill of the busiest of 3 workers mid-load (in-flight work
+    re-dispatches to the ring successor, recorded in the degrade
+    chain). Both runs must resolve every line exactly once with MRC
+    digests bit-identical to the baseline."""
+    reqs = _requests(10, seed + 57)
+    lines = [loadgen.request_jsonl(r) for r in reqs]
+    with _service(os.path.join(tmp, "fab_base"), None, seed) as svc:
+        base = _run_all(svc, reqs)
+    if not all(r.ok for r in base):
+        problems.append(
+            f"seed {seed}: fabric baseline failed: "
+            f"{[r.error for r in base if not r.ok]}"
+        )
+        return
+    baseline = _digests(base)
+
+    def judge(tag: str, run: dict, want_redispatch: bool) -> None:
+        docs = run["docs"]
+        got_ids = [d.get("id") for d in docs if d is not None]
+        if (len(docs) != len(reqs) or None in docs
+                or got_ids != [r.id for r in reqs]):
+            problems.append(
+                f"seed {seed}: fabric {tag}: {len(reqs)} lines -> "
+                f"{len([d for d in docs if d])} responses "
+                "(exactly-once violated)"
+            )
+            return
+        bad = {d["id"]: d.get("error") for d in docs
+               if not d.get("ok")}
+        if bad:
+            problems.append(
+                f"seed {seed}: fabric {tag}: requests failed: {bad}"
+            )
+        mismatch = {
+            d["id"]: (d.get("mrc_digest"), baseline.get(d["id"]))
+            for d in docs
+            if d.get("ok")
+            and d.get("mrc_digest") != baseline.get(d["id"])
+        }
+        if mismatch:
+            problems.append(
+                f"seed {seed}: fabric {tag}: ok responses are NOT "
+                f"bit-identical to the baseline: {mismatch}"
+            )
+        hopped = [
+            d["id"] for d in docs
+            if any(isinstance(g, dict)
+                   and g.get("reason") == "worker_disconnect"
+                   for g in (d.get("degraded") or []))
+        ]
+        if want_redispatch and not hopped:
+            problems.append(
+                f"seed {seed}: fabric {tag}: worker died with work "
+                "in flight but no response records a "
+                "worker_disconnect re-dispatch hop"
+            )
+        if want_redispatch and run["killed"] is not None:
+            wrong = [d["id"] for d in docs
+                     if d.get("id") in hopped
+                     and d.get("worker_id") == run["killed"]]
+            if wrong:
+                problems.append(
+                    f"seed {seed}: fabric {tag}: re-dispatched "
+                    f"requests {wrong} still attribute the dead "
+                    f"worker {run['killed']}"
+                )
+
+    # (a) partition storm: EVERY request's first send is severed
+    # mid-frame (p=1; max_fires is per (rule, key) and the router
+    # keys worker_conn on the entry seq, so each request blips exactly
+    # once and its reconnect re-send passes). The links must ride out
+    # one reconnect per dispatch without losing or doubling anything
+    injector = faults.install(FaultConfig(seed=seed, rules=(
+        {"site": "worker_conn", "kind": "disconnect", "p": 1.0,
+         "max_fires": 1},
+    )))
+    try:
+        blip = _fabric_run(seed, lines,
+                           os.path.join(tmp, "fab_blip"))
+        fired = injector.stats()["fired_by_kind"].get("disconnect", 0)
+    finally:
+        faults.uninstall()
+    judge("partition-blip", blip, want_redispatch=False)
+    if fired != len(reqs):
+        problems.append(
+            f"seed {seed}: fabric partition-blip fired {fired} "
+            f"disconnect fault(s), wanted one per request "
+            f"({len(reqs)})"
+        )
+    reconnects = sum(
+        w.get("reconnects", 0)
+        for w in blip["stats"].get("workers", {}).values()
+    )
+    if fired and not reconnects:
+        problems.append(f"seed {seed}: fabric partition-blip severed "
+                        "a link but nothing reconnected")
+
+    # (b) hard kill: 1 of 3 workers dies mid-load with work in flight
+    kill = _fabric_run(seed, lines, os.path.join(tmp, "fab_kill"),
+                       kill_after=4)
+    judge("worker-kill", kill, want_redispatch=True)
+    if kill["stats"].get("counters", {}).get("redispatched", 0) < 1:
+        problems.append(
+            f"seed {seed}: fabric worker-kill redispatched counter "
+            "is zero — the dead worker's in-flight work went nowhere"
+        )
+
+
 def check_overload(seed: int, problems: list, slow: bool) -> None:
     """The pinned overload pair: same arrivals, shed on vs off."""
     kw = dict(n=400, rate_rps=400.0, queue_limit=4, max_workers=2,
@@ -520,6 +695,7 @@ def run_seed(seed: int, slow: bool, witness: bool = False) -> list[str]:
         check_attempt_timeout(seed, problems)
         check_hedging(seed, problems)
         check_serve_line_faults(seed, problems)
+        check_fabric_chaos(seed, tmp, problems)
         check_overload(seed, problems, slow)
         if witness:
             check_witness_identity(seed, problems)

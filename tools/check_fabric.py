@@ -1,0 +1,420 @@
+"""CI gate for the multi-process serving fabric (service/fabric/).
+
+The fabric's contract is that sharding is INVISIBLE in the results:
+a request set served by a router over N workers yields the same
+fingerprints and the same MRC bytes as the single-process stack,
+cold and warm, and the consistent-hash assignment is a pure function
+of the worker-id set (stable across restarts). This gate pins all of
+that against REAL processes — the `serve-router --workers N`
+supervisor spawning full CLI worker subprocesses — because the
+in-process tests (tests/test_fabric.py) can't catch what only
+process boundaries break: argv forwarding, the ready-line handshake,
+shared-ledger appends, signal handling, and orphaned children.
+
+Phases (each on a mixed solo/duplicate/custom-program request set):
+
+  identity      the same batch through 1 worker and through 2
+                workers: per-id (ok, fingerprint, mrc_digest)
+                identical — sharding changed no bytes
+  warm          the 2-worker run repeated over its own disk cache:
+                identical digests again, zero cache misses
+  restarts      fingerprint->worker assignment read back from the
+                two 2-worker runs' ledgers is identical, and every
+                row sits on its ring assignment
+                (tools/check_ledger.py::check_worker_sharding)
+  kill          a 3-worker fabric on the TCP front: the busiest
+                worker is SIGKILLed mid-load; every request still
+                resolves exactly once, ok responses stay
+                bit-identical, and re-dispatched ones record the
+                worker_disconnect hop; SIGTERM then drains the rest
+  orphans       after every phase, no worker process survives its
+                router
+
+    python tools/check_fabric.py [--comp-cache DIR] [--keep]
+
+Wired into tier-1 by tests/test_fabric.py; the default --comp-cache
+is the test suite's persistent XLA compile cache, so worker cold
+starts skip recompiling kernels the suite already built.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+RUN_TIMEOUT_S = 300.0
+_READY_RE = re.compile(
+    r"serve-router: worker (\d+) up at \S+ \(pid (\d+)\)"
+)
+_TCP_RE = re.compile(r"JSONL TCP front on (\S+):(\d+)")
+
+
+def request_lines() -> list[str]:
+    """The mixed batch: 6 solo sampled requests with distinct
+    fingerprints, 2 byte-different duplicates of solo-0 (they must
+    coalesce/cache-hit ON solo-0's owning worker), and one inline
+    custom-program request that is the structural twin of solo-0
+    (same fingerprint through the frontend path)."""
+    from pluss_sampler_optimization_tpu.frontend import (
+        program_to_json,
+    )
+    from pluss_sampler_optimization_tpu.models import build
+
+    base = {"model": "gemm", "n": 16, "engine": "sampled",
+            "ratio": 0.2}
+    lines = [
+        json.dumps({**base, "seed": 4200 + k,
+                    "threads": 2 + (k % 3), "id": f"cf-solo-{k}"})
+        for k in range(6)
+    ]
+    for d in range(2):
+        lines.append(json.dumps({**base, "seed": 4200, "threads": 2,
+                                 "id": f"cf-dup-{d}"}))
+    lines.append(json.dumps({
+        "id": "cf-custom", "program": program_to_json(build("gemm", 16)),
+        "engine": "sampled", "ratio": 0.2, "seed": 4200, "threads": 2,
+    }))
+    return lines
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _cmd(n_workers: int, cache: str, ledger: str,
+         comp_cache: str) -> list[str]:
+    return [
+        sys.executable, "-m", "pluss_sampler_optimization_tpu.cli",
+        "serve-router", "--workers", str(n_workers),
+        "--cache-dir", cache, "--ledger", ledger,
+        "--compilation-cache-dir", comp_cache,
+        "--batch-window-ms", "5",
+    ]
+
+
+def run_batch(tag: str, n_workers: int, lines: list[str], tmp: str,
+              comp_cache: str, cache: str | None = None,
+              problems: list | None = None) -> dict:
+    """One supervisor run over the request file; returns {id: doc}."""
+    cache = cache or os.path.join(tmp, f"cache_{tag}")
+    reqs = os.path.join(tmp, f"reqs_{tag}.jsonl")
+    with open(reqs, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    cmd = _cmd(n_workers, cache, os.path.join(tmp, f"ledger_{tag}.jsonl"),
+               comp_cache) + ["--requests", reqs]
+    proc = subprocess.run(
+        cmd, cwd=REPO, env=_env(), capture_output=True, text=True,
+        timeout=RUN_TIMEOUT_S,
+    )
+    if proc.returncode != 0 and problems is not None:
+        problems.append(
+            f"{tag}: serve-router exited {proc.returncode}: "
+            f"{proc.stderr[-800:]}"
+        )
+    docs = {}
+    for ln in proc.stdout.splitlines():
+        if ln.strip():
+            doc = json.loads(ln)
+            docs[doc.get("id")] = doc
+    return docs
+
+
+def _sig(doc: dict) -> tuple:
+    return (doc.get("ok"), doc.get("fingerprint"),
+            doc.get("mrc_digest"))
+
+
+def _compare(tag: str, want: dict, got: dict, problems: list) -> None:
+    ids = sorted(want)
+    if sorted(got) != ids:
+        problems.append(f"{tag}: response ids {sorted(got)} != {ids}")
+        return
+    diff = {
+        i: (_sig(got[i]), _sig(want[i]))
+        for i in ids if _sig(got[i]) != _sig(want[i])
+    }
+    if diff:
+        problems.append(
+            f"{tag}: (ok, fingerprint, mrc_digest) diverged from the "
+            f"1-worker reference: {diff}"
+        )
+
+
+def _ledger_assignment(path: str, problems: list, tag: str,
+                       n_workers: int) -> dict:
+    """fingerprint -> worker_id from a fabric run's ledger, plus the
+    ring-sharding validation over the same rows."""
+    import check_ledger
+
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            if ln.strip():
+                rows.append(json.loads(ln))
+    got = {}
+    for row in rows:
+        if row.get("kind") == "request" and \
+                row.get("worker_id") is not None:
+            prev = got.setdefault(row["fingerprint"],
+                                  int(row["worker_id"]))
+            if prev != int(row["worker_id"]):
+                problems.append(
+                    f"{tag}: fingerprint {row['fingerprint'][:16]}... "
+                    f"served by workers {prev} AND {row['worker_id']} "
+                    "in one run (affinity broken)"
+                )
+    for v in check_ledger.check_worker_sharding(
+            rows, ring_workers=n_workers):
+        problems.append(f"{tag}: {v}")
+    if not got:
+        problems.append(f"{tag}: ledger {path} has no attributed "
+                        "request rows")
+    return got
+
+
+def orphan_pids(token: str) -> list[int]:
+    """PIDs of surviving processes whose cmdline carries `token`
+    (the run's unique tmp path — matches only our workers)."""
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        if token in cmdline and "serve-" in cmdline:
+            out.append(int(pid))
+    return out
+
+
+def _no_orphans(tag: str, token: str, problems: list) -> None:
+    for _ in range(20):  # children may still be mid-reap
+        pids = orphan_pids(token)
+        if not pids:
+            return
+        time.sleep(0.25)
+    problems.append(f"{tag}: orphaned fabric process(es) survived: "
+                    f"{pids}")
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+def check_kill_redispatch(lines: list[str], reference: dict,
+                          tmp: str, comp_cache: str,
+                          problems: list) -> None:
+    """The live-fire phase: a 3-worker fabric on the TCP front, the
+    busiest worker SIGKILLed while its requests are in flight."""
+    err_path = os.path.join(tmp, "kill_router.err")
+    cmd = _cmd(3, os.path.join(tmp, "cache_kill"),
+               os.path.join(tmp, "ledger_kill.jsonl"),
+               comp_cache) + ["--listen", "127.0.0.1:0"]
+    with open(err_path, "w") as errf:
+        router = subprocess.Popen(
+            cmd, cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
+            stderr=errf, text=True,
+        )
+    try:
+        addr, pids = None, {}
+        deadline = time.time() + RUN_TIMEOUT_S
+        while time.time() < deadline and addr is None:
+            text = open(err_path).read()
+            for wid, pid in _READY_RE.findall(text):
+                pids[int(wid)] = int(pid)
+            m = _TCP_RE.search(text)
+            if m:
+                addr = (m.group(1), int(m.group(2)))
+                break
+            if router.poll() is not None:
+                problems.append(
+                    f"kill: router died during startup: {text[-800:]}"
+                )
+                return
+            time.sleep(0.25)
+        if addr is None or len(pids) != 3:
+            problems.append(f"kill: fabric never came up "
+                            f"(addr={addr}, workers={sorted(pids)})")
+            return
+
+        sock = socket.create_connection(addr, timeout=30.0)
+        rf = sock.makefile("r", encoding="utf-8")
+        wf = sock.makefile("w", encoding="utf-8")
+        for ln in lines:
+            wf.write(ln + "\n")
+        wf.write(json.dumps({"id": "cf-hz", "type": "healthz"}) + "\n")
+        wf.flush()
+
+        want = {json.loads(ln)["id"] for ln in lines}
+        docs: dict = {}
+        victim = None
+        sock.settimeout(RUN_TIMEOUT_S)
+        while len(docs) < len(want):
+            doc = json.loads(rf.readline())
+            if doc.get("id") == "cf-hz":
+                # pick the worker with the most in-flight work — the
+                # kill must provably strand requests for re-dispatch
+                workers = doc.get("healthz", {}).get("workers", {})
+                victim = max(
+                    workers,
+                    key=lambda w: workers[w]["in_flight"],
+                )
+                if workers[victim]["in_flight"] < 1:
+                    problems.append(
+                        "kill: no worker had in-flight work at the "
+                        f"healthz probe ({workers}) — the kill phase "
+                        "proved nothing; slow the requests down"
+                    )
+                os.kill(pids[int(victim)], signal.SIGKILL)
+                continue
+            if doc.get("id") in want and doc["id"] not in docs:
+                docs[doc["id"]] = doc
+            elif doc.get("id") in docs:
+                problems.append(f"kill: duplicate response for "
+                                f"{doc['id']} (exactly-once violated)")
+        sock.close()
+
+        _compare("kill", reference, docs, problems)
+        hopped = [
+            i for i, d in docs.items()
+            if any(isinstance(g, dict)
+                   and g.get("reason") == "worker_disconnect"
+                   for g in (d.get("degraded") or []))
+        ]
+        if not hopped:
+            problems.append(
+                "kill: a worker died with work in flight but no "
+                "response records a worker_disconnect re-dispatch hop"
+            )
+        else:
+            print(f"check_fabric: kill: worker {victim} SIGKILLed, "
+                  f"{len(hopped)} request(s) re-dispatched "
+                  f"({sorted(hopped)})")
+        survivors = [d.get("worker_id") for d in docs.values()
+                     if d.get("id") in hopped]
+        if victim is not None and int(victim) in survivors:
+            problems.append(
+                f"kill: re-dispatched requests still attribute dead "
+                f"worker {victim}"
+            )
+
+        router.send_signal(signal.SIGTERM)
+        try:
+            rc = router.wait(timeout=90.0)
+        except subprocess.TimeoutExpired:
+            problems.append("kill: router did not drain on SIGTERM")
+            router.kill()
+            router.wait(timeout=10.0)
+            return
+        if rc != 0:
+            problems.append(
+                f"kill: router exited {rc} after SIGTERM drain: "
+                f"{open(err_path).read()[-800:]}"
+            )
+    finally:
+        if router.poll() is None:
+            router.kill()
+            router.wait(timeout=10.0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fabric CI gate: subprocess router+workers, "
+        "1-vs-2-worker bit-identity, restart-stable sharding, "
+        "worker-kill re-dispatch, zero orphans"
+    )
+    ap.add_argument("--comp-cache",
+                    default=os.path.join(REPO, ".jax_cache", "tests"),
+                    help="persistent XLA compile cache shared with "
+                    "the test suite (worker cold starts reuse it)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory for debugging")
+    args = ap.parse_args(argv)
+
+    problems: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="check_fabric_")
+    lines = request_lines()
+    t0 = time.perf_counter()
+    try:
+        one = run_batch("w1", 1, lines, tmp, args.comp_cache,
+                        problems=problems)
+        _no_orphans("w1", tmp, problems)
+        if len(one) != len(lines):
+            problems.append(f"w1: {len(lines)} lines -> {len(one)} "
+                            "responses")
+            raise SystemExit  # reference run broken, nothing to compare
+        bad = {i: d.get("error") for i, d in one.items()
+               if not d.get("ok")}
+        if bad:
+            problems.append(f"w1: reference requests failed: {bad}")
+        print(f"check_fabric: w1 reference in "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        two = run_batch("w2", 2, lines, tmp, args.comp_cache,
+                        problems=problems)
+        _no_orphans("w2", tmp, problems)
+        _compare("w2-cold", one, two, problems)
+
+        warm = run_batch("w2warm", 2, lines, tmp, args.comp_cache,
+                         cache=os.path.join(tmp, "cache_w2"),
+                         problems=problems)
+        _no_orphans("w2warm", tmp, problems)
+        _compare("w2-warm", one, warm, problems)
+        misses = [i for i, d in warm.items()
+                  if d.get("ok") and d.get("cache") == "miss"]
+        if misses:
+            problems.append(f"w2-warm: cache misses on a warm disk "
+                            f"cache: {misses}")
+
+        a1 = _ledger_assignment(
+            os.path.join(tmp, "ledger_w2.jsonl"), problems, "w2", 2)
+        a2 = _ledger_assignment(
+            os.path.join(tmp, "ledger_w2warm.jsonl"), problems,
+            "w2warm", 2)
+        moved = {fp: (a1[fp], a2[fp])
+                 for fp in set(a1) & set(a2) if a1[fp] != a2[fp]}
+        if moved:
+            problems.append(
+                "restart: fingerprint->worker assignment moved "
+                f"across restarts: { {k[:16]: v for k, v in moved.items()} }"
+            )
+        print(f"check_fabric: identity+warm+restart in "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        check_kill_redispatch(lines, one, tmp, args.comp_cache,
+                              problems)
+        _no_orphans("kill", tmp, problems)
+    except SystemExit:
+        pass
+    finally:
+        if args.keep:
+            print(f"check_fabric: scratch kept at {tmp}")
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    for p in problems:
+        print(f"check_fabric: FAIL: {p}", file=sys.stderr)
+    print(f"check_fabric: {len(problems)} problem(s) in "
+          f"{time.perf_counter() - t0:.1f}s")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

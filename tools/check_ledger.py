@@ -22,7 +22,15 @@ stale was found, so CI can gate on ledger health.
 
 --stats additionally prints the ledger aggregate over the valid rows
 (the CLI `stats` mode's table, including batch occupancy and
-batched-vs-solo latency joined on batch_id).
+batched-vs-solo latency joined on batch_id). When rows carry
+`worker_id` (a shared ledger written by a serving fabric,
+service/fabric/), --stats also prints the per-worker `workers:` line
+and validates that every row's worker matches its fingerprint's
+consistent-hash ring assignment (service/fabric/ring.py) — each row
+may sit at most one ring position deeper per recorded
+`worker_disconnect` re-dispatch hop in its degrade chain. A sharding
+violation means a router bug (or a mis-set --worker-id) broke
+fingerprint affinity, and fails the check like an invalid line.
 """
 
 from __future__ import annotations
@@ -50,6 +58,50 @@ def scan_ledger(path: str, max_age_days: float = 0.0,
                        max_rows=max_rows)
 
 
+def check_worker_sharding(rows, ring_workers: int = 0) -> list[str]:
+    """Fabric-sharding violations across request rows (empty = clean).
+
+    Rows carrying both `worker_id` and `fingerprint` must sit on the
+    ring where the router's consistent hash puts them: the first
+    preference entry normally, one position deeper for every
+    `worker_disconnect` re-dispatch hop recorded in the row's degrade
+    chain. The ring is rebuilt from the worker-id set (contiguous ids
+    0..max seen, the supervisor's assignment — override the fleet
+    size with `ring_workers` when workers were idle), which is valid
+    because HashRing is a pure function of the id set."""
+    from pluss_sampler_optimization_tpu.service.fabric.ring import (
+        HashRing,
+    )
+
+    sharded = [
+        row for row in rows
+        if row.get("kind") == "request"
+        and row.get("worker_id") is not None
+        and row.get("fingerprint")
+    ]
+    if not sharded:
+        return []
+    n = ring_workers or (
+        max(int(row["worker_id"]) for row in sharded) + 1
+    )
+    ring = HashRing(range(n))
+    violations = []
+    for row in sharded:
+        hops = sum(
+            1 for d in (row.get("degraded") or [])
+            if isinstance(d, dict)
+            and d.get("reason") == "worker_disconnect"
+        )
+        allowed = ring.preference(row["fingerprint"], k=1 + hops)
+        if int(row["worker_id"]) not in allowed:
+            violations.append(
+                f"fingerprint {row['fingerprint'][:16]}... served by "
+                f"worker {row['worker_id']} but the ring assigns "
+                f"{allowed} ({hops} re-dispatch hop(s) recorded)"
+            )
+    return violations
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("ledger", help="run ledger JSONL file")
@@ -67,7 +119,12 @@ def main(argv=None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="also print the ledger aggregate (per-engine "
                     "latency/cache table, batch occupancy p50/p95 and "
-                    "batched-vs-solo latency from batch_id rows)")
+                    "batched-vs-solo latency from batch_id rows; "
+                    "rows with worker_id add the per-worker line and "
+                    "the fabric ring-sharding validation)")
+    ap.add_argument("--ring-workers", type=int, default=0,
+                    help="fabric fleet size for the sharding check "
+                    "(0 = infer max worker_id + 1 from the rows)")
     args = ap.parse_args(argv)
 
     if not os.path.isfile(args.ledger):
@@ -108,14 +165,30 @@ def main(argv=None) -> int:
         + (f"; compacted to {len(scan['valid'])} rows"
            if args.gc and n_bad else "")
     )
+    shard_violations = 0
     if args.stats:
         from pluss_sampler_optimization_tpu.runtime.obs import ledger
 
         for line in ledger.format_stats(ledger.aggregate(scan["valid"])):
             print(line)
+        violations = check_worker_sharding(
+            scan["valid"], ring_workers=args.ring_workers
+        )
+        shard_violations = len(violations)
+        for v in violations:
+            print(f"{args.ledger}: SHARDING: {v}", file=sys.stderr)
+        if any(
+            row.get("worker_id") is not None for row in scan["valid"]
+        ):
+            print(
+                "sharding: "
+                + ("clean (every row on its ring assignment)"
+                   if not violations
+                   else f"{shard_violations} violation(s)")
+            )
     if args.gc:
         return 0
-    return 1 if n_bad else 0
+    return 1 if (n_bad or shard_violations) else 0
 
 
 if __name__ == "__main__":

@@ -28,6 +28,16 @@ service time becomes a knob instead of a measurement artifact.
         [--mix low:0.2,normal:0.6,high:0.2] [--burst 0.1:0.2:3] \
         [--fault-spec FILE] [--ledger PATH] [--json PATH]
 
+With --connect HOST:PORT the same deterministic arrival sequence is
+driven over TCP against a live `serve --listen` or fabric
+`serve-router --listen` endpoint (service/fabric/) instead of an
+in-process service: requests go out as JSONL lines at their computed
+offsets, a reader thread matches response documents back by id, and
+the report has the same shape — so a fabric run is directly
+comparable to the in-process baseline. Service-side knobs
+(--queue-limit, --max-workers, --service-time-s, --compare-shed)
+don't apply over TCP; configure the server process instead.
+
 Reused as a library by tools/check_chaos.py (the chaos gate's
 overload phase) and bench.py (the `overload_shedding` extra).
 """
@@ -35,9 +45,11 @@ overload phase) and bench.py (the `overload_shedding` extra).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import math
 import os
+import socket
 import sys
 import threading
 import time
@@ -235,6 +247,112 @@ def run_load(service, requests: list, offsets: list[float],
     return report
 
 
+def request_jsonl(req) -> str:
+    """An AnalysisRequest as one serve_jsonl wire line: the dataclass
+    fields with Nones dropped (parse_request_line refills defaults),
+    so the server-side parse — and therefore the fingerprint — is
+    identical to submitting the same request in-process."""
+    doc = {
+        k: v for k, v in dataclasses.asdict(req).items()
+        if v is not None
+    }
+    return json.dumps(doc, sort_keys=True)
+
+
+def connect_run(addr: str, requests: list, offsets: list[float],
+                timeout_s: float = 120.0) -> dict:
+    """run_load over TCP: submit `requests` open-loop at `offsets` as
+    JSONL lines to a serve/serve-router listener, match response docs
+    by id, and fold the same goodput/tail-latency report.
+
+    Responses arrive as-ready (the router interleaves workers), so a
+    reader thread collects them concurrently with submission — the
+    loop stays open exactly like the in-process path. Requests whose
+    response never arrives inside timeout_s count as failed.
+    """
+    from pluss_sampler_optimization_tpu.service.fabric import wire
+
+    host, port = wire.parse_hostport(addr)
+    want = {r.id for r in requests}
+    docs: dict = {}
+    done = threading.Event()
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    rfile = sock.makefile("r", encoding="utf-8", newline="\n")
+    wfile = sock.makefile("w", encoding="utf-8", newline="\n")
+
+    def reader() -> None:
+        try:
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) and doc.get("id") in want:
+                    docs[doc["id"]] = doc
+                    if len(docs) == len(want):
+                        break
+        except OSError:
+            pass
+        finally:
+            done.set()  # EOF/complete: whatever arrived is final
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=reader, name="loadgen-recv",
+                          daemon=True)
+    th.start()
+    try:
+        for req, off in zip(requests, offsets):
+            now = time.perf_counter() - t0
+            if off > now:
+                time.sleep(off - now)
+            wfile.write(request_jsonl(req) + "\n")
+            wfile.flush()
+        done.wait(timeout=timeout_s)
+    finally:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        sock.close()
+    th.join(timeout=5.0)
+    wall = time.perf_counter() - t0
+
+    got = list(docs.values())
+    ok = [d for d in got if d.get("ok")]
+    shed = [d for d in got if d.get("shed")]
+    failed = sum(
+        1 for r in requests
+        if not (docs.get(r.id) or {}).get("ok")
+        and not (docs.get(r.id) or {}).get("shed")
+    )
+    lats = sorted(
+        d["latency_s"] for d in ok
+        if d.get("latency_s") is not None
+    )
+    report = {
+        "connect": f"{host}:{port}",
+        "submitted": len(requests),
+        "ok": len(ok),
+        "shed": len(shed),
+        "failed": failed,
+        "missing": len(want) - len(docs),
+        "retried": sum(d.get("retries", 0) for d in got),
+        "hedged": sum(1 for d in got if d.get("hedged")),
+        "wall_s": round(wall, 4),
+        "offered_rps": round(len(requests) / max(1e-9, wall), 2),
+        "goodput_rps": round(len(ok) / max(1e-9, wall), 2),
+    }
+    for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+        report[f"latency_{name}_s"] = (
+            round(obs_ledger._percentile(lats, q), 6) if lats
+            else None
+        )
+    return report
+
+
 def _strip(report: dict) -> dict:
     return {k: v for k, v in report.items() if k != "responses"}
 
@@ -345,6 +463,11 @@ def main(argv=None) -> int:
                     "fingerprints (rest hit a small hot set)")
     ap.add_argument("--burst", default=None,
                     help="start:duration:multiplier rate burst")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="drive a live serve/serve-router TCP "
+                    "listener instead of an in-process service "
+                    "(service-side knobs like --queue-limit belong "
+                    "to the server process then)")
     ap.add_argument("--fault-spec", default=None,
                     help="arm runtime/faults.py from this JSON spec "
                     "for the duration of the run")
@@ -365,8 +488,21 @@ def main(argv=None) -> int:
         injector = faults.install_from_file(args.fault_spec)
         print(f"loadgen: faults armed (seed {injector.config.seed}, "
               f"{len(injector.config.rules)} rule(s))")
+    if args.connect and args.compare_shed:
+        raise SystemExit(
+            "--compare-shed builds an in-process service pair; it "
+            "cannot target --connect (run the server twice instead)"
+        )
     try:
-        if args.compare_shed:
+        if args.connect:
+            reqs = make_requests(args.requests, args.seed, mix=mix,
+                                 unique_frac=args.unique_frac)
+            offs = arrival_offsets(args.requests, args.rate,
+                                   args.seed, burst=burst)
+            report = connect_run(args.connect, reqs, offs,
+                                 timeout_s=args.timeout_s)
+            headline = report
+        elif args.compare_shed:
             report = overload_comparison(
                 n=args.requests, rate_rps=args.rate,
                 queue_limit=args.queue_limit,
