@@ -816,6 +816,23 @@ def main(argv=None) -> int:
         metavar="SECONDS",
         help="fabric: pause between reconnect attempts (default 0.2)",
     )
+    ap.add_argument(
+        "--stats-interval-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="serve-router: fleet telemetry poll period — how often "
+        "the router pulls each worker's stats/metrics/slo_inputs "
+        "snapshot over the wire (default 5)",
+    )
+    ap.add_argument(
+        "--no-fabric-trace",
+        action="store_true",
+        help="serve-router: disable distributed tracing (no trace "
+        "blocks on wire frames, no router ledger rows). MRC bytes "
+        "are bit-identical either way — tracing is pure serving "
+        "metadata (pinned by tests/test_fabric.py)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_models:
@@ -875,6 +892,9 @@ def main(argv=None) -> int:
              args.reconnect_attempts is not None),
             ("--reconnect-delay-s",
              args.reconnect_delay_s is not None),
+            ("--stats-interval-s",
+             args.stats_interval_s is not None),
+            ("--no-fabric-trace", args.no_fabric_trace),
         ) if on
     ]
     if _fabric_flags and args.mode not in ("serve-worker",
@@ -892,13 +912,13 @@ def main(argv=None) -> int:
             )
         if args.workers is not None and args.workers < 1:
             raise SystemExit("--workers must be >= 1")
+        # --slo-latency-p95-s/--slo-error-budget are fleet-level on
+        # the router: the sentinel evaluates over the workers' merged
+        # slo_inputs (runtime/obs/fleet.FleetView), not local engine
+        # counters
         _worker_side = [
             flag for flag, on in (
                 ("--profile-hz", args.profile_hz is not None),
-                ("--slo-latency-p95-s",
-                 args.slo_latency_p95_s is not None),
-                ("--slo-error-budget",
-                 args.slo_error_budget is not None),
                 ("--regress-bench", args.regress_bench is not None),
                 ("--ledger-gc-interval-s",
                  args.ledger_gc_interval_s is not None),
@@ -1292,10 +1312,13 @@ def _fabric_from_args(args):
 
     kw = {}
     for attr in ("hb_interval_s", "hb_timeout_s",
-                 "reconnect_attempts", "reconnect_delay_s"):
+                 "reconnect_attempts", "reconnect_delay_s",
+                 "stats_interval_s"):
         v = getattr(args, attr)
         if v is not None:
             kw[attr] = v
+    if args.no_fabric_trace:
+        kw["trace_enabled"] = False
     return FabricConfig(**kw)
 
 
@@ -1425,6 +1448,7 @@ def _serve_router(args) -> int:
     from .runtime.obs import metrics as obs_metrics
     from .runtime.obs import profiler as obs_profiler
     from .runtime.obs import recorder as obs_recorder
+    from .runtime.obs import slo as obs_slo
     from .service import GracefulShutdown
     from .service.fabric import Router, parse_hostport
 
@@ -1464,6 +1488,7 @@ def _serve_router(args) -> int:
                     "cache_dir", "ledger", "workers", "worker",
                     "listen", "hb_interval_s", "hb_timeout_s",
                     "reconnect_attempts", "reconnect_delay_s",
+                    "stats_interval_s", "no_fabric_trace",
                     "fault_spec", "debug_bundle_dir",
                 )
             },
@@ -1489,7 +1514,45 @@ def _serve_router(args) -> int:
             addrs = _spawn_workers(args, children)
         else:
             addrs = [parse_hostport(spec) for spec in args.worker]
-        router = Router(addrs, fabric=fabric).start()
+        # the router shares the workers' O_APPEND ledger: its rows
+        # (source fabric.router, per-request span splits) join the
+        # worker rows on trace_id — tools/assemble_trace.py
+        router = Router(addrs, fabric=fabric,
+                        ledger_path=args.ledger)
+        if (args.slo_latency_p95_s is not None
+                or args.slo_error_budget is not None):
+            from .config import SLOConfig
+            from .runtime.obs import fleet as obs_fleet
+
+            kw = {"burn_rate_threshold": args.slo_burn_threshold}
+            if args.slo_latency_p95_s is not None:
+                kw["latency_p95_s"] = args.slo_latency_p95_s
+            if args.slo_error_budget is not None:
+                kw["error_budget"] = args.slo_error_budget
+            slo_config = SLOConfig(**kw)
+            # workers pre-digest their windows against this threshold
+            # (fabric/worker.py _slo_inputs); the sentinel then reads
+            # the fleet as one registry through FleetView. No ledger
+            # leg here — the shared ledger holds router rows too, and
+            # the workers' own sentinels already watch their tails
+            router.slo_params = {
+                "threshold": args.slo_latency_p95_s,
+                "windows": list(slo_config.windows),
+            }
+            sentinel = obs_slo.SLOSentinel(
+                slo_config, registry=obs_fleet.FleetView(router),
+                interval_s=args.slo_interval_s,
+            )
+            router.slo_sentinel = sentinel
+        router.start()
+        if router.slo_sentinel is not None:
+            router.slo_sentinel.start()
+            print(
+                "serve-router: fleet SLO sentinel on (burn rates "
+                "over the merged worker windows, every "
+                f"{args.slo_interval_s:g}s)",
+                file=sys.stderr,
+            )
         if recorder is not None:
             recorder.state_provider = lambda: {
                 "healthz": router.healthz(),
@@ -1498,7 +1561,12 @@ def _serve_router(args) -> int:
         if args.metrics_port is not None:
             server = obs_metrics.MetricsServer(
                 registry, port=args.metrics_port,
-                healthz=router.healthz, stats=router.stats,
+                healthz=router.healthz,
+                # cached snapshots (refreshed every stats_interval_s
+                # by the poll loop) — a scrape never blocks on N
+                # worker round-trips
+                stats=(lambda: router.fleet_stats(refresh=False)),
+                prometheus=router.fleet_prometheus_text,
                 bundles=(
                     (lambda: {
                         "bundle_dir": recorder.bundle_dir,
@@ -1535,6 +1603,18 @@ def _serve_router(args) -> int:
             file=sys.stderr,
         )
     finally:
+        if router is not None and router.slo_sentinel is not None:
+            try:
+                # final fleet evaluation so short batches (finished
+                # inside one interval) still report, matching _serve
+                router.slo_sentinel.evaluate_once()
+                for line in obs_slo.format_report(
+                    router.slo_sentinel.last_report
+                ):
+                    print(f"serve-router: {line}", file=sys.stderr)
+            except Exception:
+                pass
+            router.slo_sentinel.close()
         if router is not None:
             router.close(graceful=True)
         for proc in children:

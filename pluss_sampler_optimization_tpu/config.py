@@ -394,6 +394,13 @@ class FabricConfig:
         executions) before giving up the drain.
       ring_vnodes: virtual nodes per worker on the consistent-hash
         ring (service/fabric/ring.py).
+      trace_enabled: attach trace blocks to request frames, measure
+        per-request wire/worker spans, and append router-side ledger
+        rows. Pure observability — toggling it never changes MRC
+        bytes or fingerprints (pinned in tests/test_fabric.py).
+      stats_interval_s: how often the router polls each worker's
+        telemetry snapshot over a `stats` frame (feeds the merged
+        fleet stats/metrics view and the fleet SLO sentinel).
     """
 
     hb_interval_s: float = 2.0
@@ -403,6 +410,8 @@ class FabricConfig:
     connect_timeout_s: float = 10.0
     drain_timeout_s: float = 60.0
     ring_vnodes: int = 64
+    trace_enabled: bool = True
+    stats_interval_s: float = 5.0
 
     def __post_init__(self) -> None:
         if self.hb_interval_s <= 0:
@@ -422,6 +431,8 @@ class FabricConfig:
             raise ValueError("drain_timeout_s must be > 0")
         if self.ring_vnodes < 1:
             raise ValueError("ring_vnodes must be >= 1")
+        if self.stats_interval_s <= 0:
+            raise ValueError("stats_interval_s must be > 0")
 
 
 # Sites and kinds the fault injector (runtime/faults.py) understands.

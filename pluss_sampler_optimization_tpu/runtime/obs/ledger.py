@@ -67,6 +67,19 @@ ACCEPTED_VERSIONS = (1, 2)
 
 KINDS = ("request", "drift", "bench")
 
+# `source` of the fabric router's OWN request rows
+# (service/fabric/router.py): one per traced routed response, carrying
+# the `router` span block (wire/queue overhead, owning worker) and
+# joining the worker's "service" row on trace_id. Aggregation rolls
+# them into the `fleet` section — NEVER into the request/engine stats,
+# which would double-count every fabric-served request.
+ROUTER_SOURCE = "fabric.router"
+
+# numeric span fields a `router` block may carry (all optional and
+# nullable; tools/assemble_trace.py turns them into Chrome trace spans)
+ROUTER_SPANS = ("router_queue_s", "route_s", "wire_out_s",
+                "worker_rtt_s", "wire_back_s", "wire_s", "worker_s")
+
 # cache dispositions a request row may carry: None = direct engine run
 # (no store in the path), "miss" = cold service execution, "mem" /
 # "disk" = warm service tiers
@@ -183,6 +196,20 @@ def validate_row(row) -> list[str]:
         # additionally validates rows shard by ring assignment
         if "worker_id" in row:
             need_num("worker_id", nullable=True)
+        # the fabric router's span block (source fabric.router rows):
+        # which worker the request was routed to plus the router-side
+        # monotonic-delta spans assemble_trace joins on trace_id
+        if "router" in row:
+            rb = row["router"]
+            if not isinstance(rb, dict):
+                errors.append("'router' must be an object")
+            else:
+                for key in ("worker_id", "hops") + ROUTER_SPANS:
+                    v = rb.get(key)
+                    if v is not None and not _is_num(v):
+                        errors.append(
+                            f"'router.{key}' must be a number or null"
+                        )
         if "request" in row and not isinstance(row["request"], dict):
             errors.append("'request' must be an object")
         # ir-preflight verdict (service/api.py static-analysis gate):
@@ -443,9 +470,38 @@ def aggregate(rows: list[dict]) -> dict:
     # processes (service/fabric/) shards by worker_id; this is the
     # offline face of the router's per-link dispatch counters
     workers: dict = {}
+    # the router's OWN rows (source fabric.router): per-worker routed
+    # share + wire/queue overhead percentiles. They describe the same
+    # requests the worker rows do, so they are rolled up HERE and
+    # excluded from every request/engine/service stat below — counting
+    # them there would double every fabric-served request
+    fleet_workers: dict = {}
+    fleet_wire: list[float] = []
+    fleet_overhead: list[float] = []
     for row in rows:
         kind = row["kind"]
         by_kind[kind] = by_kind.get(kind, 0) + 1
+        if kind == "request" and row.get("source") == ROUTER_SOURCE:
+            rb = row.get("router") or {}
+            wid = rb.get("worker_id")
+            f = fleet_workers.setdefault(
+                int(wid) if wid is not None else -1,
+                {"rows": 0, "ok": 0, "redispatched": 0},
+            )
+            f["rows"] += 1
+            if row["ok"]:
+                f["ok"] += 1
+            if rb.get("hops"):
+                f["redispatched"] += 1
+            if rb.get("wire_s") is not None:
+                fleet_wire.append(float(rb["wire_s"]))
+            parts = [rb.get("router_queue_s"), rb.get("route_s"),
+                     rb.get("wire_s")]
+            if any(p is not None for p in parts):
+                fleet_overhead.append(
+                    sum(float(p) for p in parts if p is not None)
+                )
+            continue
         if kind == "request":
             if row.get("source") == "service":
                 joiners = int(row.get("coalesced") or 0)
@@ -588,6 +644,23 @@ def aggregate(rows: list[dict]) -> dict:
         ),
         "solo_p50_latency_s": round(_percentile(lat_solo, 0.50), 6),
     }
+    fleet = None
+    if fleet_workers:
+        total = sum(f["rows"] for f in fleet_workers.values())
+        for f in fleet_workers.values():
+            f["share"] = round(f["rows"] / total, 3) if total else 0.0
+        fleet_wire.sort()
+        fleet_overhead.sort()
+        fleet = {
+            "rows": total,
+            "workers": fleet_workers,
+            "wire_p50_s": round(_percentile(fleet_wire, 0.50), 6),
+            "wire_p95_s": round(_percentile(fleet_wire, 0.95), 6),
+            "overhead_p50_s": round(
+                _percentile(fleet_overhead, 0.50), 6),
+            "overhead_p95_s": round(
+                _percentile(fleet_overhead, 0.95), 6),
+        }
     return {
         "rows": len(rows),
         "by_kind": by_kind,
@@ -600,6 +673,7 @@ def aggregate(rows: list[dict]) -> dict:
         "service": service,
         "replicas": replicas,
         "workers": workers,
+        "fleet": fleet,
     }
 
 
@@ -698,6 +772,24 @@ def format_stats(agg: dict) -> list[str]:
         )
         lines.append(
             "workers: %d fabric worker(s), %s" % (len(fws), parts)
+        )
+    fl = agg.get("fleet")
+    if fl:
+        parts = " ".join(
+            "w%d=%.0f%%%s" % (
+                wid, f["share"] * 100,
+                (" (redisp %d)" % f["redispatched"])
+                if f["redispatched"] else "",
+            )
+            for wid, f in sorted(fl["workers"].items())
+        )
+        lines.append(
+            "fleet: %d routed rows, share %s, wire p50=%.6fs "
+            "p95=%.6fs, overhead p50=%.6fs p95=%.6fs" % (
+                fl["rows"], parts, fl["wire_p50_s"],
+                fl["wire_p95_s"], fl["overhead_p50_s"],
+                fl["overhead_p95_s"],
+            )
         )
     svc = agg.get("service")
     if svc and svc["submitted"]:

@@ -474,14 +474,19 @@ class MetricsServer:
     passes `profiler.snapshot`) backs `GET /debug/profile`: the live
     sampling-profiler snapshot when the profiler is running, and a
     structured 404 JSON body (not a bare HTML error page) when it is
-    off, so pollers always get machine-readable state. `port=0` binds
-    an ephemeral port (read it back from `.port`). Serves 404
-    elsewhere and never raises into the serving thread."""
+    off, so pollers always get machine-readable state. The optional
+    `prometheus` callable overrides the `/metrics` body entirely (the
+    fabric router passes its merged fleet exposition,
+    fabric/router.py `fleet_prometheus_text`, so one scrape covers
+    every worker); when it raises, the local registry is served as
+    the fallback. `port=0` binds an ephemeral port (read it back from
+    `.port`). Serves 404 elsewhere and never raises into the serving
+    thread."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  host: str = "127.0.0.1", prefix: str = "pluss_",
                  healthz=None, stats=None, bundles=None,
-                 profile=None):
+                 profile=None, prometheus=None):
         import http.server
 
         reg = registry
@@ -515,9 +520,16 @@ class MetricsServer:
                 status = 200
                 if path in ("/metrics", "/"):
                     try:
-                        body = reg.prometheus_text(
-                            prefix=prefix
-                        ).encode()
+                        if prometheus is not None:
+                            try:
+                                text = prometheus()
+                            except Exception:
+                                text = reg.prometheus_text(
+                                    prefix=prefix
+                                )
+                        else:
+                            text = reg.prometheus_text(prefix=prefix)
+                        body = text.encode()
                         ctype = ("text/plain; version=0.0.4; "
                                  "charset=utf-8")
                     except Exception as e:  # pragma: no cover

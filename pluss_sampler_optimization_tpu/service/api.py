@@ -277,6 +277,13 @@ class AnalysisResponse:
     shed: bool = False
     retries: int = 0
     hedged: bool = False
+    # worker-side stage timings (serving metadata, monotonic deltas on
+    # the executing process's clock). Over a fabric these let a client
+    # split end-to-end latency into worker time vs routing + wire
+    # overhead without any clock agreement (tools/loadgen.py --connect
+    # reports exactly that)
+    queue_s: float | None = None
+    execute_s: float | None = None
 
     def to_jsonl_dict(self) -> dict:
         """The wire form `serve` emits: compact — the MRC ships in the
@@ -310,6 +317,10 @@ class AnalysisResponse:
             d["retries"] = self.retries
         if self.hedged:
             d["hedged"] = True
+        if self.queue_s is not None:
+            d["queue_s"] = self.queue_s
+        if self.execute_s is not None:
+            d["execute_s"] = self.execute_s
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
@@ -343,6 +354,8 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             shed=bool(outcome.get("shed")),
             retries=int(outcome.get("retries") or 0),
             hedged=bool(outcome.get("hedged")),
+            queue_s=outcome.get("queue_s"),
+            execute_s=outcome.get("execute_s"),
         )
     return AnalysisResponse(
         id=request.id,
@@ -367,6 +380,8 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         preflight=outcome.get("preflight"),
         retries=int(outcome.get("retries") or 0),
         hedged=bool(outcome.get("hedged")),
+        queue_s=outcome.get("queue_s"),
+        execute_s=outcome.get("execute_s"),
     )
 
 

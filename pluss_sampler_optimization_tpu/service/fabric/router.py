@@ -17,11 +17,15 @@ Routing rules, in order:
 - oversize lines (> api.MAX_REQUEST_LINE_BYTES) are refused AT the
   router with serve_jsonl's exact error + best-effort id echo (the
   payload never travels);
-- `healthz`/`stats` control lines answer ROUTER-locally with the
-  fabric view (link states, dispatch counters); `metrics`/
-  `dump_debug` (and unknown types, and malformed lines) forward by
-  content digest — the owning worker produces the identical
-  structured response/error serve_jsonl would;
+- control lines answer AT the router: `healthz` with the fabric view
+  (link states, per-link heartbeat RTT), `stats`/`metrics` with the
+  MERGED fleet view (per-worker sections polled over `stats` frames
+  plus numeric fleet sums / summed registry snapshots), and
+  `dump_debug` by fanning out to every worker and writing a router
+  bundle that indexes the per-worker bundles by trace_id; unknown
+  types and malformed lines still forward by content digest — the
+  owning worker produces the identical structured error serve_jsonl
+  would;
 - everything else routes by its service fingerprint, computed here
   exactly as the worker will compute it (memoized per canonical
   payload), falling back to the line's content digest when the line
@@ -53,12 +57,22 @@ import re
 import socket
 import threading
 import time
+import uuid
+from collections import deque
 
 from ...runtime import faults
+from ...runtime.obs import ledger as obs_ledger
+from ...runtime.obs import metrics as obs_metrics
 from .. import api
 from ..fingerprint import content_digest
 from . import wire
 from .ring import HashRing
+
+# Live-registry histogram names the router observes into (module-level
+# obs_metrics.observe: no-ops when no registry is enabled). Per-link
+# series ride the same names with a `_worker_<id>` suffix.
+HB_RTT_HISTOGRAM = "fabric_hb_rtt_s"
+WIRE_HISTOGRAM = "fabric_wire_s"
 
 
 def _id_echo(line: str) -> str | None:
@@ -71,8 +85,9 @@ class Entry:
     """One routed request line: resolved exactly once."""
 
     __slots__ = ("seq", "line", "line_no", "req_id", "fp", "owner",
-                 "hops", "degrade", "doc", "_event", "_callback",
-                 "_lock")
+                 "hops", "degrade", "doc", "trace_id", "span_id",
+                 "meta", "t_created", "t_routed", "t_sent", "_event",
+                 "_callback", "_lock")
 
     def __init__(self, seq: int, line: str, line_no: int):
         self.seq = seq
@@ -84,6 +99,14 @@ class Entry:
         self.hops = 0
         self.degrade: list = []
         self.doc: dict | None = None
+        # trace context + span stamps (router-local perf_counter —
+        # every span is a single-host monotonic delta)
+        self.trace_id: str | None = None
+        self.span_id: str | None = None
+        self.meta: dict | None = None  # parsed model/n/engine
+        self.t_created = time.perf_counter()
+        self.t_routed: float | None = None
+        self.t_sent: float | None = None
         self._event = threading.Event()
         self._callback = None
         self._lock = threading.Lock()
@@ -120,6 +143,15 @@ class WorkerLink:
         self.inflight: dict[int, Entry] = {}
         self.dispatched = 0
         self.reconnects = 0
+        # heartbeat RTTs (token-matched pongs) + the wall time of the
+        # last pong, for healthz's rtt_p95_s / last_pong_age_s
+        self.rtts: deque = deque(maxlen=64)
+        self.last_pong: float | None = None
+        # the worker's latest periodic telemetry snapshot (stats
+        # frames), feeding the merged fleet stats//metrics view
+        self.last_snapshot: dict | None = None
+        self.last_snapshot_at: float | None = None
+        self._stats_waiters: dict = {}  # token -> [Event, payload]
         self._conn: wire.Conn | None = None
         self._lock = threading.Lock()
         self._closed = threading.Event()
@@ -154,11 +186,19 @@ class WorkerLink:
         conn = self._conn
         if conn is None:
             return
+        frame = {"type": "request", "seq": entry.seq,
+                 "line": entry.line, "line_no": entry.line_no}
+        if entry.trace_id is not None:
+            frame["trace"] = {
+                "trace_id": entry.trace_id,
+                "span_id": entry.span_id,
+                "sent_s": round(time.perf_counter(), 6),
+            }
         try:
             faults.fire("worker_conn", key=entry.seq,
                         worker_id=self.worker_id)
-            conn.send({"type": "request", "seq": entry.seq,
-                       "line": entry.line, "line_no": entry.line_no})
+            entry.t_sent = time.perf_counter()
+            conn.send(frame)
         except wire.FrameTooLarge:
             # this entry can never travel: answer it, don't kill the
             # link (pop first so re-dispatch cannot double-answer)
@@ -239,18 +279,84 @@ class WorkerLink:
             kind = frame.get("type")
             if kind == "response":
                 self.router._on_response(self, frame)
+            elif kind == "pong":
+                self._on_pong(frame)
+            elif kind == "stats":
+                self._on_stats(frame)
             elif kind == "bye":
                 self._bye.set()
                 return
-            # pong/error frames are just liveness traffic
+            # error frames are just liveness traffic
 
     def ping(self) -> None:
         conn = self._conn
         if self.state == "up" and conn is not None:
             try:
-                conn.send({"type": "ping", "t": time.time()})
+                # the token is this process's perf_counter: the echo
+                # yields the link RTT from one monotonic clock
+                conn.send({"type": "ping",
+                           "t": time.perf_counter()})
             except (wire.WireError, OSError):
                 conn.close()
+
+    def _on_pong(self, frame: dict) -> None:
+        """Pongs used to be discarded liveness traffic; the echoed
+        token now yields the per-link heartbeat RTT."""
+        self.last_pong = time.time()
+        t = frame.get("t")
+        if not isinstance(t, (int, float)):
+            return
+        rtt = time.perf_counter() - float(t)
+        if rtt < 0:  # a pre-restart token echoed late
+            return
+        self.rtts.append(rtt)
+        obs_metrics.observe(HB_RTT_HISTOGRAM, rtt)
+        obs_metrics.observe(
+            f"{HB_RTT_HISTOGRAM}_worker_{self.worker_id}", rtt
+        )
+
+    def rtt_p95_s(self) -> float | None:
+        rtts = sorted(self.rtts)
+        if not rtts:
+            return None
+        return rtts[min(len(rtts) - 1, int(0.95 * (len(rtts) - 1)))]
+
+    # -- fleet telemetry ----------------------------------------------
+
+    def request_stats(self, want, extra: dict | None = None,
+                      timeout: float = 5.0) -> dict | None:
+        """Synchronously poll this worker's telemetry snapshot over a
+        `stats` frame; None when the link is down or the worker does
+        not answer inside `timeout`."""
+        conn = self._conn
+        if self.state != "up" or conn is None:
+            return None
+        token = self.router._next_stats_token()
+        waiter = [threading.Event(), None]
+        with self._lock:
+            self._stats_waiters[token] = waiter
+        frame = {"type": "stats", "token": token, "want": list(want)}
+        if extra:
+            frame.update(extra)
+        try:
+            conn.send(frame)
+        except (wire.WireError, OSError):
+            with self._lock:
+                self._stats_waiters.pop(token, None)
+            conn.close()
+            return None
+        waiter[0].wait(timeout)
+        with self._lock:
+            self._stats_waiters.pop(token, None)
+        snap = waiter[1]
+        return snap if isinstance(snap, dict) else None
+
+    def _on_stats(self, frame: dict) -> None:
+        with self._lock:
+            waiter = self._stats_waiters.pop(frame.get("token"), None)
+        if waiter is not None:
+            waiter[1] = frame.get("snapshot")
+            waiter[0].set()
 
     def drain_inflight(self) -> list[Entry]:
         with self._lock:
@@ -286,31 +392,52 @@ class WorkerLink:
 class Router:
     """The fabric's dispatch plane over a set of worker addresses."""
 
-    def __init__(self, worker_addrs, fabric=None):
+    def __init__(self, worker_addrs, fabric=None,
+                 ledger_path: str | None = None):
         from ...config import FabricConfig
 
         if not worker_addrs:
             raise ValueError("router needs at least one worker "
                              "address")
         self.fabric = fabric if fabric is not None else FabricConfig()
+        # the router's OWN schema-v2 rows (source fabric.router): one
+        # per traced response, carrying the span block that
+        # tools/assemble_trace.py joins with the worker's row
+        self.ledger_path = ledger_path
+        # burn-rate parameters forwarded with every periodic stats
+        # poll so workers pre-digest slo_inputs; the CLI sets this
+        # from SLOConfig when the fleet sentinel is wired
+        self.slo_params: dict | None = None
+        self.slo_sentinel = None  # fleet SLOSentinel (CLI-attached)
         self.links = [
             WorkerLink(self, i, host, port)
             for i, (host, port) in enumerate(worker_addrs)
         ]
         self._ring: HashRing | None = None
         self._seq = 0
+        self._stats_token = 0
         self._lock = threading.Lock()
         self._fp_memo: dict[str, str] = {}
         self._draining = False
         self._listener: socket.socket | None = None
         self._client_threads: list[threading.Thread] = []
         self._ticker: threading.Thread | None = None
+        self._stats_ticker: threading.Thread | None = None
         self._stop = threading.Event()
+        # trace_id -> worker_id for the last traced responses: the
+        # dump_debug fan-out bundle's per-request index
+        self._recent_traces: deque = deque(maxlen=256)
         self.counters = {
             "lines": 0, "routed": 0, "local": 0, "redispatched": 0,
             "responses": 0, "dropped_stale": 0, "no_worker": 0,
-            "tcp_clients": 0,
+            "tcp_clients": 0, "stats_polls": 0, "router_rows": 0,
+            "ledger_write_failed": 0,
         }
+
+    def _next_stats_token(self) -> int:
+        with self._lock:
+            self._stats_token += 1
+            return self._stats_token
 
     # -- lifecycle -----------------------------------------------------
 
@@ -335,6 +462,11 @@ class Router:
             daemon=True,
         )
         self._ticker.start()
+        self._stats_ticker = threading.Thread(
+            target=self._stats_loop, name="pluss-fabric-stats",
+            daemon=True,
+        )
+        self._stats_ticker.start()
         return self
 
     def _heartbeat_loop(self) -> None:
@@ -342,17 +474,37 @@ class Router:
             for link in self.links:
                 link.ping()
 
+    def _stats_loop(self) -> None:
+        """Periodic fleet telemetry poll: refresh every live link's
+        snapshot so stats//metrics/GET /metrics and the fleet SLO
+        sentinel read recent per-worker data without blocking."""
+        interval = self.fabric.stats_interval_s
+        while not self._stop.wait(interval):
+            if self._draining:
+                continue
+            try:
+                self.poll_workers(
+                    ("stats", "metrics", "slo_inputs"),
+                    timeout=min(interval, 5.0), store=True,
+                )
+            except Exception:
+                pass  # telemetry must never take routing down
+
     def alive_ids(self) -> set:
         return {link.worker_id for link in self.links
                 if link.state != "dead"}
 
     # -- routing -------------------------------------------------------
 
-    def _routing_fingerprint(self, line: str) -> str:
-        """The worker's service fingerprint for this line — computed
-        HERE with the same parse/build path (jax-free), memoized per
-        canonical payload; content digest for lines a worker will
-        refuse (their errors need determinism, not affinity)."""
+    def _routing_fingerprint(self, line: str
+                             ) -> tuple[str, dict | None]:
+        """(fingerprint, meta) for this line. The fingerprint is the
+        worker's service fingerprint — computed HERE with the same
+        parse/build path (jax-free), memoized per canonical payload;
+        content digest for lines a worker will refuse (their errors
+        need determinism, not affinity; meta is None for those).
+        `meta` carries the parsed serving metadata the router's own
+        ledger row needs (model/n/engine + any caller trace_id)."""
         try:
             request = api.parse_request_line(line)
             key = json.dumps(request.payload(), sort_keys=True,
@@ -363,9 +515,13 @@ class Router:
                 if len(self._fp_memo) >= 4096:
                     self._fp_memo.clear()
                 self._fp_memo[key] = fp
-            return fp
+            return fp, {
+                "model": request.model, "n": request.n,
+                "engine": request.engine,
+                "trace_id": request.trace_id,
+            }
         except Exception:
-            return content_digest({"line": line})
+            return content_digest({"line": line}), None
 
     def submit_line(self, line: str, line_no: int = 0) -> Entry:
         """Route one JSONL line; returns its Entry (resolving to the
@@ -392,17 +548,31 @@ class Router:
             doc = None
         if isinstance(doc, dict):
             entry.req_id = doc.get("id")
-        if isinstance(doc, dict) and doc.get("type") in ("healthz",
-                                                         "stats"):
+        if isinstance(doc, dict) and doc.get("type") in (
+            "healthz", "stats", "metrics", "dump_debug"
+        ):
             # fabric-local introspection: the router IS the authority
-            # on link/dispatch state; per-process engine introspection
-            # rides metrics/dump_debug lines to a worker instead
+            # on link/dispatch state AND — via the stats-frame fan-out
+            # — on the merged fleet view, so no control line rides to
+            # one arbitrary worker anymore: stats/metrics answer with
+            # per-worker sections plus fleet sums, dump_debug makes
+            # EVERY worker (and the router) write a bundle
             kind = doc["type"]
-            payload = (self.healthz() if kind == "healthz"
-                       else self.stats())
+            try:
+                payload = {
+                    "healthz": self.healthz,
+                    "stats": self.fleet_stats,
+                    "metrics": self.fleet_metrics,
+                    "dump_debug": self.fleet_dump_debug,
+                }[kind]()
+                out = {"id": entry.req_id, "ok": True,
+                       "type": kind, kind: payload}
+            except Exception as e:
+                out = {"id": entry.req_id, "ok": False,
+                       "line": line_no,
+                       "error": f"introspection failed: {e!r}"}
             self.counters["local"] += 1
-            self._resolve(entry, {"id": entry.req_id, "ok": True,
-                                  "type": kind, kind: payload})
+            self._resolve(entry, out)
             return entry
         if self._draining:
             self._resolve(entry, {
@@ -411,11 +581,19 @@ class Router:
                 "error": "shed: router shutting down",
             })
             return entry
-        entry.fp = self._routing_fingerprint(line)
+        entry.fp, entry.meta = self._routing_fingerprint(line)
+        if self.fabric.trace_enabled and entry.meta is not None:
+            # adopt the caller's trace_id when the line names one
+            # (the worker parses the same bytes and agrees), mint
+            # otherwise — either way router and worker rows join
+            entry.trace_id = (entry.meta.get("trace_id")
+                              or uuid.uuid4().hex[:16])
+            entry.span_id = uuid.uuid4().hex[:16]
         self._route(entry)
         return entry
 
     def _route(self, entry: Entry) -> None:
+        entry.t_routed = time.perf_counter()
         try:
             wid = self._ring.assign(entry.fp, alive=self.alive_ids())
         except LookupError:
@@ -432,6 +610,7 @@ class Router:
     # -- link events ---------------------------------------------------
 
     def _on_response(self, link: WorkerLink, frame: dict) -> None:
+        t_done = time.perf_counter()
         seq = frame.get("seq")
         doc = frame.get("doc")
         entry = link.take(seq) if isinstance(seq, int) else None
@@ -453,7 +632,85 @@ class Router:
                 doc.get("degraded") or []
             )
         self.counters["responses"] += 1
+        if entry.trace_id is not None:
+            try:
+                self._record_spans(link, entry, frame, doc, t_done)
+            except Exception:
+                self.counters["ledger_write_failed"] += 1
         self._resolve(entry, doc)
+
+    def _record_spans(self, link: WorkerLink, entry: Entry,
+                      frame: dict, doc: dict, t_done: float) -> None:
+        """Per-request router spans: every duration is a delta on THIS
+        process's perf_counter; the worker contributes only its own
+        recv->send delta (`worker_s`), so the wire split needs no
+        cross-host clock agreement. wire_s = RTT - worker_s, halved
+        into out/back (symmetric-path estimate, Cristian's
+        algorithm)."""
+        trace = frame.get("trace")
+        worker_s = (trace.get("worker_s")
+                    if isinstance(trace, dict) else None)
+        rtt = (t_done - entry.t_sent
+               if entry.t_sent is not None else None)
+        wire_s = None
+        if (rtt is not None and isinstance(worker_s, (int, float))):
+            wire_s = max(0.0, rtt - float(worker_s))
+        self._recent_traces.append(
+            {"trace_id": entry.trace_id, "worker_id": link.worker_id}
+        )
+        if wire_s is not None:
+            obs_metrics.observe(WIRE_HISTOGRAM, wire_s,
+                                exemplar=entry.trace_id)
+            obs_metrics.observe(
+                f"{WIRE_HISTOGRAM}_worker_{link.worker_id}", wire_s
+            )
+        if self.ledger_path is None or entry.meta is None:
+            return
+
+        def _span(v):
+            return None if v is None else round(float(v), 6)
+
+        cache = doc.get("cache")
+        row = {
+            "kind": "request",
+            "source": obs_ledger.ROUTER_SOURCE,
+            "ok": bool(doc.get("ok")),
+            "fingerprint": entry.fp,
+            "engine_requested": entry.meta["engine"],
+            "engine_used": doc.get("engine_used"),
+            "model": entry.meta["model"],
+            "n": entry.meta["n"],
+            "latency_s": _span(t_done - entry.t_created),
+            "cache": (cache if cache in obs_ledger.CACHE_TIERS
+                      else None),
+            "degraded": list(doc.get("degraded") or []),
+            "mrc_digest": doc.get("mrc_digest"),
+            "trace_id": entry.trace_id,
+            "span_id": entry.span_id,
+            "router": {
+                "worker_id": link.worker_id,
+                "hops": entry.hops,
+                "router_queue_s": _span(
+                    entry.t_routed - entry.t_created
+                    if entry.t_routed is not None else None),
+                "route_s": _span(
+                    entry.t_sent - entry.t_routed
+                    if entry.t_sent is not None
+                    and entry.t_routed is not None else None),
+                "worker_rtt_s": _span(rtt),
+                "worker_s": _span(worker_s),
+                "wire_s": _span(wire_s),
+                "wire_out_s": _span(
+                    wire_s / 2 if wire_s is not None else None),
+                "wire_back_s": _span(
+                    wire_s / 2 if wire_s is not None else None),
+            },
+        }
+        try:
+            obs_ledger.append(self.ledger_path, row)
+            self.counters["router_rows"] += 1
+        except Exception:
+            self.counters["ledger_write_failed"] += 1
 
     def _on_link_dead(self, link: WorkerLink) -> None:
         """Reconnects exhausted: re-dispatch the dead worker's
@@ -505,6 +762,7 @@ class Router:
     # -- introspection -------------------------------------------------
 
     def healthz(self) -> dict:
+        now = time.time()
         return {
             "status": ("ok" if self.alive_ids() else "no_workers"),
             "role": "router",
@@ -513,6 +771,11 @@ class Router:
                     "addr": f"{link.host}:{link.port}",
                     "state": link.state,
                     "in_flight": len(link.inflight),
+                    "rtt_p95_s": link.rtt_p95_s(),
+                    "last_pong_age_s": (
+                        round(now - link.last_pong, 3)
+                        if link.last_pong is not None else None
+                    ),
                 }
                 for link in self.links
             },
@@ -533,6 +796,164 @@ class Router:
                 for link in self.links
             },
         }
+
+    # -- fleet telemetry ----------------------------------------------
+
+    def poll_workers(self, want, timeout: float = 5.0,
+                     store: bool = False) -> dict:
+        """Fan a `stats` frame out to every live link (one thread
+        each — a stuck worker can't serialize the poll) and collect
+        {worker_id: snapshot}. `store` keeps each snapshot on its link
+        for the non-blocking readers (GET /metrics, the sentinel)."""
+        extra = ({"slo": self.slo_params}
+                 if self.slo_params is not None else None)
+        results: dict = {}
+        lock = threading.Lock()
+
+        def _one(link: WorkerLink) -> None:
+            snap = link.request_stats(want, extra=extra,
+                                      timeout=timeout)
+            if snap is None:
+                return
+            with lock:
+                results[link.worker_id] = snap
+            if store:
+                # merge by section: a narrow poll (say metrics-only)
+                # must not blank the slo_inputs the sentinel reads
+                link.last_snapshot = {
+                    **(link.last_snapshot or {}), **snap
+                }
+                link.last_snapshot_at = time.time()
+
+        threads = []
+        for link in self.links:
+            if link.state != "up":
+                continue
+            t = threading.Thread(
+                target=_one, args=(link,),
+                name=f"pluss-fabric-poll-{link.worker_id}",
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout + 1.0)
+        self.counters["stats_polls"] += 1
+        return results
+
+    def _worker_snapshots(self, want, refresh: bool,
+                          timeout: float = 5.0) -> dict:
+        """{worker_id: snapshot} — freshly polled, or each link's last
+        periodic snapshot when `refresh` is False (falling back to one
+        live poll if nothing has been collected yet)."""
+        if refresh:
+            return self.poll_workers(want, timeout=timeout,
+                                     store=True)
+        snaps = {
+            link.worker_id: link.last_snapshot
+            for link in self.links
+            if link.last_snapshot is not None
+        }
+        if snaps:
+            return snaps
+        return self.poll_workers(want, timeout=timeout, store=True)
+
+    def fleet_stats(self, refresh: bool = True) -> dict:
+        """The `stats` control line's fleet answer: the router-local
+        view plus each worker's `stats` section and the numeric fleet
+        sums (runtime/obs/fleet.py) — consistent with the
+        single-process shapes per worker, summed per fleet."""
+        from ...runtime.obs import fleet as obs_fleet
+
+        snaps = self._worker_snapshots(
+            ("stats", "metrics", "slo_inputs"), refresh
+        )
+        return obs_fleet.fleet_stats(self.stats(), snaps)
+
+    def fleet_metrics(self, refresh: bool = True) -> dict:
+        """The `metrics` control line's fleet answer: per-worker
+        registry snapshots merged with the router's own registry
+        (counters/histogram buckets summed — the same shape a
+        single-process `metrics` response has), plus the per-worker
+        originals and the fleet SLO report when a sentinel runs."""
+        from ...runtime.obs import fleet as obs_fleet
+
+        snaps = self._worker_snapshots(("metrics",), refresh)
+        reg = obs_metrics.get()
+        out = obs_fleet.fleet_metrics(
+            reg.snapshot() if reg is not None else None, snaps
+        )
+        if self.slo_sentinel is not None:
+            out["slo"] = self.slo_sentinel.last_report
+        return out
+
+    def fleet_prometheus_text(self, prefix: str = "pluss_") -> str:
+        """GET /metrics for the router: the merged fleet exposition
+        (router registry + every worker's last-polled snapshot summed
+        bucket-by-bucket). Reads the periodic snapshots — a scrape
+        never blocks on N workers."""
+        from ...runtime.obs import fleet as obs_fleet
+
+        snaps = self._worker_snapshots(("metrics",), refresh=False,
+                                       timeout=2.0)
+        reg = obs_metrics.get()
+        merged = obs_fleet.merge_registry_snapshots(
+            ([reg.snapshot()] if reg is not None else [])
+            + [s.get("metrics") for s in snaps.values()
+               if isinstance(s.get("metrics"), dict)]
+        )
+        gauges = merged.setdefault("gauges", {})
+        gauges["fabric_workers_up"] = sum(
+            1 for link in self.links if link.state == "up"
+        )
+        for link in self.links:
+            gauges[f"fabric_in_flight_worker_{link.worker_id}"] = len(
+                link.inflight
+            )
+        from ...runtime.obs import exporters
+
+        return "\n".join(exporters.prometheus_registry_lines(
+            merged, prefix=prefix
+        )) + "\n"
+
+    def fleet_dump_debug(self) -> dict:
+        """The `dump_debug` control line's fleet answer: every worker
+        writes its own bundle (stats-frame fan-out), then the router
+        writes one more whose trigger indexes the per-worker bundle
+        paths and the recent trace_id -> worker_id routing decisions —
+        one request, one joined post-mortem."""
+        from ...runtime.obs import recorder as obs_recorder
+
+        snaps = self.poll_workers(
+            ("dump_debug",), timeout=self.fabric.drain_timeout_s
+        )
+        workers = {
+            str(wid): snap.get("dump_debug")
+            for wid, snap in snaps.items()
+        }
+        rec = obs_recorder.get()
+        out: dict = {
+            "enabled": rec is not None or any(
+                isinstance(w, dict) and w.get("enabled")
+                for w in workers.values()
+            ),
+            "fleet": True,
+            "workers": workers,
+            "trace_index": list(self._recent_traces),
+        }
+        if rec is not None:
+            out["bundle"] = rec.dump("dump_debug", trigger={
+                "fan_out": {
+                    wid: (w or {}).get("bundle")
+                    for wid, w in workers.items()
+                    if isinstance(w, dict)
+                },
+                "trace_index": list(self._recent_traces),
+            })
+            out["bundle_dir"] = rec.bundle_dir
+            out["recorder"] = rec.stats()
+            out["bundles"] = rec.bundle_index()
+        return out
 
     # -- serving fronts ------------------------------------------------
 
@@ -681,3 +1102,6 @@ class Router:
                 })
         if self._ticker is not None and self._ticker.is_alive():
             self._ticker.join(timeout=2.0)
+        if (self._stats_ticker is not None
+                and self._stats_ticker.is_alive()):
+            self._stats_ticker.join(timeout=2.0)

@@ -18,11 +18,31 @@ Frame vocabulary (the `type` field):
               line>, "line_no": M}. The RAW line is forwarded, so the
               worker's parse/validate/fingerprint path is byte-for-
               byte the one serve_jsonl runs — the transport cannot
-              change what a request means.
+              change what a request means. May carry an optional
+              `trace` block {"trace_id": hex16, "span_id": hex16,
+              "sent_s": <sender perf_counter>}: the worker ADOPTS the
+              caller's trace_id (unless the raw line itself names
+              one, which both sides then agree on), so worker ledger
+              rows, exemplars, and bundles join the router's view of
+              the same request. Trace context never enters the
+              request payload or fingerprint — placement and tracing
+              are both invisible to the MRC bytes.
     response  worker -> router: {"seq": N, "doc": <serve response
               dict>}. Out-of-order by design; the router re-orders by
               seq for file mode and matches by id for TCP clients.
-    ping/pong heartbeats (router pings, worker echoes the `t` token).
+              May carry `trace` {"trace_id": hex16, "worker_s":
+              <worker-side recv->send delta, its own monotonic
+              clock>} so the router can split its measured RTT into
+              wire time vs worker time without cross-host clocks.
+    ping/pong heartbeats (router pings, worker echoes the `t` token;
+              the router matches tokens to measure per-link RTT).
+    stats     both directions. Router -> worker {"token": N, "want":
+              [...], ...} requests a telemetry snapshot; the worker
+              replies {"token": N, "snapshot": {...}} with one key
+              per `want` entry (healthz/stats/metrics/slo_inputs/
+              dump_debug). This is how the router serves the merged
+              fleet view of `stats`/`metrics` and fans `dump_debug`
+              out to every worker.
     shutdown  router -> worker: drain in-flight work, answer
               everything, reply `bye`, and stop.
     bye       worker -> router: drain complete, closing.
@@ -40,7 +60,10 @@ import socket
 import struct
 import threading
 
-WIRE_VERSION = 1
+# v2: optional `trace` blocks on request/response frames + the
+# `stats` frame type (fleet telemetry). The handshake still gates on
+# exact equality — both ends ship in this repo.
+WIRE_VERSION = 2
 
 # Frame payload cap: the serve protocol's 1 MiB request-line budget,
 # times 4 for the envelope's JSON re-escaping (every quote/backslash

@@ -28,10 +28,12 @@ partition scenario tools/check_chaos.py pins).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import re
 import socket
 import threading
+import time
 from concurrent.futures import CancelledError
 
 from ...runtime import faults
@@ -39,7 +41,8 @@ from .. import api
 from . import wire
 
 
-def handle_line(service, line: str, line_no: int = 0):
+def handle_line(service, line: str, line_no: int = 0,
+                trace_id: str | None = None):
     """serve_jsonl's per-line read-pass semantics for ONE line.
 
     Returns ("doc", response_dict) for lines answerable immediately
@@ -48,6 +51,13 @@ def handle_line(service, line: str, line_no: int = 0):
     and builds the response with `response_doc`. Mirrors
     api.serve_jsonl branch for branch so fabric-served lines produce
     identical structured responses.
+
+    `trace_id` is the router-propagated trace context: a parsed
+    request that names no trace_id of its own ADOPTS it (so the worker
+    ledger row, exemplars, and bundles join the router's view of the
+    request) — a trace_id in the raw line wins, and both sides agree
+    on it since the router parses the same bytes. Trace context is
+    serving metadata: it never enters the payload or fingerprint.
     """
     line = line.strip()
     doc_id = None
@@ -109,6 +119,8 @@ def handle_line(service, line: str, line_no: int = 0):
                             "error": f"introspection failed: {e!r}"})
     try:
         request = api.parse_request_line(line)
+        if trace_id and request.trace_id is None:
+            request = dataclasses.replace(request, trace_id=trace_id)
         ticket = service.submit(request)
         return ("ticket", ticket, request)
     except Exception as e:
@@ -143,6 +155,85 @@ def response_doc(ticket, request, line_no: int = 0) -> dict:
         }
 
 
+# Snapshot sections a `stats` frame may request; also the default
+# when the frame names none.
+STATS_SECTIONS = ("healthz", "stats", "metrics", "slo_inputs",
+                  "dump_debug")
+DEFAULT_STATS_WANT = ("stats", "metrics", "slo_inputs")
+
+
+def _slo_inputs(slo: dict | None) -> dict:
+    """Pre-digested burn-rate inputs from THIS process's live
+    registry, for the router's fleet SLO sentinel: per-window latency
+    violation fraction (against the router-supplied threshold),
+    window observation count (the merge weight), the observed p95,
+    and the windowed service_* counters. All monotonic-window reads —
+    nothing here needs clock agreement with the router."""
+    from ...runtime.obs import metrics as obs_metrics
+    from ...runtime.obs.slo import LATENCY_HISTOGRAM
+
+    reg = obs_metrics.get()
+    if reg is None:
+        return {"enabled": False, "windows": {}}
+    slo = slo if isinstance(slo, dict) else {}
+    threshold = slo.get("threshold")
+    labels = slo.get("windows") or list(reg.window_labels())
+    hist = reg.snapshot().get("histograms", {}).get(
+        LATENCY_HISTOGRAM, {})
+    out: dict = {"enabled": True, "threshold": threshold,
+                 "histogram": LATENCY_HISTOGRAM, "windows": {}}
+    for lbl in labels:
+        try:
+            win = {
+                "latency_count": int(
+                    hist.get("windows", {}).get(lbl, {})
+                    .get("count") or 0
+                ),
+                "latency_p95": reg.histogram_quantile(
+                    LATENCY_HISTOGRAM, lbl, 0.95
+                ),
+                "service_submitted": reg.counter_window(
+                    "service_submitted", lbl),
+                "service_failed": reg.counter_window(
+                    "service_failed", lbl),
+                "service_degraded": reg.counter_window(
+                    "service_degraded", lbl),
+            }
+            win["latency_frac_over"] = (
+                reg.histogram_fraction_over(
+                    LATENCY_HISTOGRAM, lbl, float(threshold)
+                ) if threshold is not None else None
+            )
+        except KeyError:
+            continue  # a window label this registry doesn't keep
+        out["windows"][lbl] = win
+    return out
+
+
+def telemetry_snapshot(service, want=None, slo: dict | None = None
+                       ) -> dict:
+    """The worker's answer to a `stats` frame: one key per requested
+    section. Sections map onto the serve protocol's control responses
+    (healthz/stats/metrics/dump_debug) plus the fleet-only
+    `slo_inputs`; a section that fails reports {"error": ...} in
+    place so one broken subsystem can't blank the whole poll."""
+    if not isinstance(want, (list, tuple)) or not want:
+        want = DEFAULT_STATS_WANT
+    out: dict = {}
+    for key in want:
+        if key not in STATS_SECTIONS:
+            out[str(key)] = {"error": f"unknown section {key!r}"}
+            continue
+        try:
+            if key == "slo_inputs":
+                out[key] = _slo_inputs(slo)
+            else:
+                out[key] = getattr(service, key)()
+        except Exception as e:
+            out[key] = {"error": repr(e)}
+    return out
+
+
 class WorkerServer:
     """One fabric worker endpoint over an AnalysisService."""
 
@@ -168,6 +259,7 @@ class WorkerServer:
         self.stats_counters = {
             "connections": 0, "requests": 0, "responses": 0,
             "handshake_rejected": 0, "faults_disconnect": 0,
+            "stats_polls": 0,
         }
 
     # -- lifecycle -----------------------------------------------------
@@ -287,6 +379,8 @@ class WorkerServer:
                 conn.send({"type": "pong", "t": frame.get("t")})
             elif kind == "request":
                 self._handle_request(conn, frame)
+            elif kind == "stats":
+                self._handle_stats(conn, frame)
             elif kind == "shutdown":
                 self._drain(conn)
                 return
@@ -296,11 +390,15 @@ class WorkerServer:
                     "error": f"unknown frame type {kind!r}",
                 })
 
-    def _send_response(self, conn: wire.Conn, seq, doc: dict) -> None:
+    def _send_response(self, conn: wire.Conn, seq, doc: dict,
+                       trace: dict | None = None) -> None:
         doc = dict(doc)
         doc["worker_id"] = self.worker_id
+        frame = {"type": "response", "seq": seq, "doc": doc}
+        if trace is not None:
+            frame["trace"] = trace
         try:
-            conn.send({"type": "response", "seq": seq, "doc": doc})
+            conn.send(frame)
             self.stats_counters["responses"] += 1
         except (wire.WireError, OSError):
             # link already dead — the router will re-dispatch this seq
@@ -312,12 +410,27 @@ class WorkerServer:
         seq = frame.get("seq")
         line = frame.get("line")
         line_no = int(frame.get("line_no") or 0)
+        t_recv = time.perf_counter()
+        trace_in = frame.get("trace")
+        trace_id = (trace_in.get("trace_id")
+                    if isinstance(trace_in, dict) else None)
+
+        def _trace_out() -> dict | None:
+            # the router's RTT minus this delta is the wire time; both
+            # deltas are single-host monotonic, so no clock agreement
+            # between router and worker is ever assumed
+            if trace_id is None:
+                return None
+            return {"trace_id": trace_id,
+                    "worker_s": round(
+                        time.perf_counter() - t_recv, 6)}
+
         self.stats_counters["requests"] += 1
         if not isinstance(line, str):
             self._send_response(conn, seq, {
                 "id": None, "ok": False, "line": line_no,
                 "error": "request frame without a 'line' string",
-            })
+            }, trace=_trace_out())
             return
         try:
             faults.fire("worker_exec", key=seq,
@@ -333,11 +446,13 @@ class WorkerServer:
             self._send_response(conn, seq, {
                 "id": None, "ok": False, "line": line_no,
                 "error": f"fault injected: {e}",
-            })
+            }, trace=_trace_out())
             return
-        handled = handle_line(self.service, line, line_no)
+        handled = handle_line(self.service, line, line_no,
+                              trace_id=trace_id)
         if handled[0] == "doc":
-            self._send_response(conn, seq, handled[1])
+            self._send_response(conn, seq, handled[1],
+                                trace=_trace_out())
             return
         _tag, ticket, request = handled
         with self._lock:
@@ -348,10 +463,28 @@ class WorkerServer:
             with self._lock:
                 self._outstanding.pop(seq, None)
             self._send_response(
-                conn, seq, response_doc(ticket, request, line_no)
+                conn, seq, response_doc(ticket, request, line_no),
+                trace=_trace_out(),
             )
 
         ticket.future.add_done_callback(_done)
+
+    # -- fleet telemetry ----------------------------------------------
+
+    def _handle_stats(self, conn: wire.Conn, frame: dict) -> None:
+        """`stats` frame: build the requested telemetry snapshot and
+        echo the token. A broken section must never take the link (or
+        the worker) down — it is reported in place."""
+        self.stats_counters["stats_polls"] += 1
+        snapshot = telemetry_snapshot(
+            self.service, frame.get("want"), slo=frame.get("slo")
+        )
+        try:
+            conn.send({"type": "stats", "token": frame.get("token"),
+                       "worker_id": self.worker_id,
+                       "snapshot": snapshot})
+        except (wire.WireError, OSError):
+            pass  # router re-polls after reconnecting
 
     def _drain(self, conn: wire.Conn) -> None:
         """`shutdown` frame: stop reading, await every accepted

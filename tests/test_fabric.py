@@ -24,7 +24,13 @@ The acceptance invariants pinned here:
   process (router + each worker).
 - tools/check_fabric.py (subprocess supervisor, 1-vs-2-worker digest
   identity, restart-stable sharding, worker-kill re-dispatch, zero
-  orphans) passes from tier-1.
+  orphans, fleet telemetry) passes from tier-1.
+- TRACING (ISSUE 19): a client-supplied trace_id propagates over the
+  wire into the worker's own ledger row; the router writes one span
+  row per request; fleet stats equal the sum of the workers' own
+  counters; runtime/obs/fleet.py assembles one Chrome trace per
+  request from ledger rows alone; and MRC bytes are bit-identical
+  with tracing on vs off.
 """
 
 import glob
@@ -176,21 +182,28 @@ def _mixed_lines() -> list[str]:
 
 
 def _run_fabric(n_workers: int, cache_dir, lines,
-                solo: bool = False) -> dict:
+                solo: bool = False, cfg: FabricConfig = _CFG,
+                ledger: str | None = None,
+                probe: dict | None = None) -> dict:
     """Serve `lines` through an in-process router over n real worker
     stacks; returns {id: response doc}. solo=True submits one line at
-    a time (each awaited before the next), the anti-batch."""
+    a time (each awaited before the next), the anti-batch. `ledger`
+    gives every worker AND the router the same ledger file; `probe`
+    is filled with live fleet telemetry (polled over `stats` wire
+    frames) before the router closes."""
     services = [
-        AnalysisService(cache_dir=str(cache_dir), max_workers=2)
-        for _ in range(n_workers)
+        AnalysisService(cache_dir=str(cache_dir), max_workers=2,
+                        ledger_path=ledger, worker_id=i)
+        for i in range(n_workers)
     ]
     workers = []
     try:
         for i, svc in enumerate(services):
-            ws = WorkerServer(svc, worker_id=i, fabric=_CFG)
+            ws = WorkerServer(svc, worker_id=i, fabric=cfg)
             ws.start()
             workers.append(ws)
-        router = Router([ws.address for ws in workers], _CFG)
+        router = Router([ws.address for ws in workers], cfg,
+                        ledger_path=ledger)
         router.start()
         try:
             if solo:
@@ -207,6 +220,9 @@ def _run_fabric(n_workers: int, cache_dir, lines,
                 )
                 docs = [json.loads(ln)
                         for ln in fout.getvalue().splitlines()]
+            if probe is not None:
+                probe["stats"] = router.fleet_stats(refresh=True)
+                probe["prometheus"] = router.fleet_prometheus_text()
         finally:
             router.close(graceful=True)
     finally:
@@ -225,14 +241,48 @@ def _sig(doc: dict) -> tuple:
 
 # -- the tentpole invariant --------------------------------------------
 
+# the client-supplied trace id pinned on fb-1 (ISSUE 19): it must
+# ride the wire into the worker's own ledger row
+TRACE_PIN = "cafe" * 4
 
-def test_bit_identity_1_vs_3_workers_cold_warm_solo_batched(tmp_path):
+
+@pytest.fixture(scope="module")
+def fabric3_cold(tmp_path_factory):
+    """ONE cold 3-worker ledger-backed fabric run shared by the
+    bit-identity tentpole and the tracing/fleet tests (a fabric spin
+    costs seconds; the invariants they pin are independent reads of
+    the same run). Tracing is on (the default) and fb-1 carries a
+    client-supplied trace_id."""
+    tmp = tmp_path_factory.mktemp("fabric3")
+    lines = []
+    for ln in _mixed_lines():
+        d = json.loads(ln)
+        if d["id"] == "fb-1":
+            d["trace_id"] = TRACE_PIN
+        lines.append(json.dumps(d))
+    ledger = str(tmp / "ledger.jsonl")
+    probe: dict = {}
+    docs = _run_fabric(3, tmp / "store", lines, ledger=ledger,
+                       probe=probe)
+    return {"lines": lines, "store": tmp / "store",
+            "ledger": ledger, "docs": docs, "probe": probe}
+
+
+def test_bit_identity_1_vs_3_workers_cold_warm_solo_batched(
+        tmp_path, fabric3_cold):
     """Same bytes no matter the topology: serve_jsonl directly vs a
     1-worker fabric vs a 3-worker fabric, cold and warm, batched
     stream and solo submits — identical (ok, fingerprint, mrc_digest,
     engine_used) per id, and the duplicate/custom twins coalesce onto
-    fb-0's fingerprint through the fabric exactly as in-process."""
-    lines = _mixed_lines()
+    fb-0's fingerprint through the fabric exactly as in-process.
+    The solo warm run additionally disables fabric tracing
+    (FabricConfig.trace_enabled=False): trace context is serving
+    metadata on the frame, never part of the forwarded line, the
+    fingerprint, or the result — so tracing on vs off changes no
+    bytes either."""
+    import dataclasses
+
+    lines = fabric3_cold["lines"]
     with AnalysisService(cache_dir=str(tmp_path / "direct"),
                          max_workers=2) as svc:
         fout = io.StringIO()
@@ -247,13 +297,16 @@ def test_bit_identity_1_vs_3_workers_cold_warm_solo_batched(tmp_path):
         == direct["fb-0"]["fingerprint"]
 
     one = _run_fabric(1, tmp_path / "f1", lines)
-    three = _run_fabric(3, tmp_path / "f3", lines)
-    warm_batched = _run_fabric(3, tmp_path / "f3", lines)
-    warm_solo = _run_fabric(3, tmp_path / "f3", lines, solo=True)
+    three = fabric3_cold["docs"]
+    store = fabric3_cold["store"]
+    warm_batched = _run_fabric(3, store, lines)
+    warm_solo = _run_fabric(
+        3, store, lines, solo=True,
+        cfg=dataclasses.replace(_CFG, trace_enabled=False))
 
     for tag, docs in (("1w-cold", one), ("3w-cold", three),
                       ("3w-warm", warm_batched),
-                      ("3w-warm-solo", warm_solo)):
+                      ("3w-warm-solo-notrace", warm_solo)):
         assert {i: _sig(d) for i, d in docs.items()} == want, tag
         assert all("worker_id" in d for d in docs.values()), tag
     # warm runs on the shared disk tier: fresh processes, zero misses
@@ -442,6 +495,129 @@ def test_fabric_sigterm_drain_subprocess(tmp_path):
                                      "BUNDLE_*_shutdown.json"))
         assert got, f"worker {wid} wrote no shutdown bundle " \
             f"({err[-500:]})"
+
+
+# -- fabric-wide tracing & fleet telemetry (ISSUE 19) ------------------
+
+
+def test_trace_propagation_fleet_stats_and_assembly(fabric3_cold):
+    """Reads of the shared 3-worker ledger-backed run: a
+    client-supplied trace_id rides the request line through the
+    router INTO the worker's own ledger row; the router writes one
+    span row per request (no top-level worker_id); fleet stats polled
+    over `stats` wire frames sum to the workers' own counters; and
+    runtime/obs/fleet.py assembles one Chrome trace per request from
+    the ledger rows alone."""
+    import check_ledger
+
+    from pluss_sampler_optimization_tpu.runtime.obs import (
+        fleet as obs_fleet,
+        ledger as obs_ledger,
+    )
+
+    lines = fabric3_cold["lines"]
+    docs = fabric3_cold["docs"]
+    assert all(d["ok"] for d in docs.values())
+    # worker-side stage timings ride the response — the loadgen
+    # --connect overhead split feeds on execute_s
+    assert all(d.get("execute_s") is not None for d in docs.values())
+
+    # fleet stats polled over `stats` frames while the router was
+    # live: fleet == sum(workers), per-INSTANCE executor counters
+    # (the shared in-process registry can't tell workers apart; the
+    # subprocess check_fabric fleet phase covers registry merging)
+    fs = fabric3_cold["probe"]["stats"]
+    assert fs["role"] == "router"
+    assert fs["fleet"]["workers"] == 3
+    assert len(fs["worker_stats"]) == 3
+    per = [w["executor"]["submitted"]
+           for w in fs["worker_stats"].values()]
+    assert fs["fleet"]["executor"]["submitted"] == sum(per)
+    assert sum(per) == len(lines)
+    # the merged Prometheus plane names the fabric gauges
+    assert "pluss_fabric_workers_up 3" \
+        in fabric3_cold["probe"]["prometheus"]
+
+    rows = obs_ledger.read_rows(fabric3_cold["ledger"])
+    router_rows = [r for r in rows
+                   if r.get("source") == obs_ledger.ROUTER_SOURCE]
+    worker_rows = [r for r in rows
+                   if r.get("kind") == "request"
+                   and r.get("worker_id") is not None]
+    assert len(router_rows) == len(lines)
+    # router span rows never carry a top-level worker_id — sharding
+    # attribution lives in the nested `router` block
+    assert all("worker_id" not in r for r in router_rows)
+    assert all(r["router"]["worker_id"] in (0, 1, 2)
+               for r in router_rows)
+    # every worker request row joins a router trace (the
+    # check_ledger gate's trace-join validation agrees)
+    assert check_ledger.check_trace_join(rows) == []
+    # the client-supplied trace id survived the whole wire path
+    assert any(r["trace_id"] == TRACE_PIN for r in router_rows)
+    assert any(r.get("trace_id") == TRACE_PIN for r in worker_rows)
+
+    # one Chrome trace per request, from the ledger rows alone
+    traces = obs_fleet.assemble_traces(rows)
+    assert set(traces) == {r["trace_id"] for r in router_rows}
+    doc = traces[TRACE_PIN]
+    names = [ev["name"] for ev in doc["traceEvents"]]
+    assert names[:2] == ["process_name", "process_name"]
+    assert names[2:8] == ["request", "router_queue", "route",
+                          "worker_rtt", "wire_out", "wire_back"]
+    assert "worker" in names and "execute" in names
+    # the worker span sits INSIDE the router's RTT window (the wire
+    # split places it; 5 us of slack absorbs 6-dp rounding)
+    by = {ev["name"]: ev for ev in doc["traceEvents"]
+          if ev.get("ph") == "X"}
+    rtt = by["worker_rtt"]
+    wk = by["worker"]
+    assert rtt["ts"] <= wk["ts"] + 5.0
+    assert wk["ts"] + wk["dur"] <= rtt["ts"] + rtt["dur"] + 5.0
+
+
+def test_assemble_chrome_trace_golden():
+    """Pinned layout: given fixed span values, the assembled Chrome
+    trace is byte-deterministic and every event lands exactly where
+    the monotonic-delta arithmetic puts it (t=0 at router submit, the
+    worker track at queue+route+wire_out)."""
+    from pluss_sampler_optimization_tpu.runtime.obs import (
+        fleet as obs_fleet,
+    )
+
+    router_row = {
+        "trace_id": "feedface00000001", "span_id": "r1",
+        "fingerprint": "fp", "model": "gemm",
+        "engine_requested": "sampled", "ok": True, "cache": "miss",
+        "latency_s": 0.01, "source": "fabric.router",
+        "router": {"worker_id": 1, "hops": 1,
+                   "router_queue_s": 0.001, "route_s": 0.0005,
+                   "worker_rtt_s": 0.008, "worker_s": 0.006,
+                   "wire_s": 0.002, "wire_out_s": 0.001,
+                   "wire_back_s": 0.001},
+    }
+    worker_row = {"worker_id": 1, "span_id": "w1", "cache": "miss",
+                  "latency_s": 0.006, "queue_s": 0.001,
+                  "execute_s": 0.005}
+    doc = obs_fleet.assemble_chrome_trace(router_row, [worker_row])
+    spans = [(e["name"], e["ts"], e["dur"])
+             for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans == [
+        ("request", 0.0, 10000.0),
+        ("router_queue", 0.0, 1000.0),
+        ("route", 1000.0, 500.0),
+        ("worker_rtt", 1500.0, 8000.0),
+        ("wire_out", 1500.0, 1000.0),
+        ("wire_back", 8500.0, 1000.0),
+        ("worker", 2500.0, 6000.0),
+        ("queue", 2500.0, 1000.0),
+        ("execute", 3500.0, 5000.0),
+    ]
+    text = obs_fleet.trace_text(doc)
+    assert text == obs_fleet.trace_text(
+        obs_fleet.assemble_chrome_trace(router_row, [worker_row]))
+    assert json.loads(text)["otherData"]["trace_id"] \
+        == "feedface00000001"
 
 
 # -- the subprocess CI gate --------------------------------------------

@@ -27,6 +27,14 @@ Phases (each on a mixed solo/duplicate/custom-program request set):
                 resolves exactly once, ok responses stay
                 bit-identical, and re-dispatched ones record the
                 worker_disconnect hop; SIGTERM then drains the rest
+  fleet         a 2-worker fabric on the TCP front with tracing and
+                a flight recorder: after the batch, the router's
+                ledger rows JOIN every worker row on trace_id
+                (tools/check_ledger.py::check_trace_join and one
+                assembled Chrome trace per request), the `metrics`
+                control line's merged counters equal the sum of its
+                per-worker sections, and `dump_debug` fans out — one
+                bundle per worker plus the router's own
   orphans       after every phase, no worker process survives its
                 router
 
@@ -335,11 +343,221 @@ def check_kill_redispatch(lines: list[str], reference: dict,
             router.wait(timeout=10.0)
 
 
+def check_fleet_observability(lines: list[str], reference: dict,
+                              tmp: str, comp_cache: str,
+                              problems: list) -> None:
+    """The fleet-telemetry phase: a 2-worker fabric on the TCP front
+    with tracing on and the flight recorder armed. Runs the batch,
+    then asserts (1) the shared ledger's trace join — every worker
+    row's trace_id appears in a router row, and every request
+    assembles into a Chrome trace from ledger rows alone; (2) the
+    merged `metrics` view is consistent — fleet counters equal the
+    sum of the per-worker sections; (3) `dump_debug` fans out — a
+    bundle per worker plus the router's own."""
+    import check_ledger
+
+    from pluss_sampler_optimization_tpu.runtime.obs import fleet
+
+    err_path = os.path.join(tmp, "fleet_router.err")
+    ledger_path = os.path.join(tmp, "ledger_fleet.jsonl")
+    bundle_dir = os.path.join(tmp, "bundles_fleet")
+    # reuse the identity phase's warm disk cache: the batch is all
+    # hits, so this phase pays only process startup — trace spans and
+    # ledger rows are written for hits exactly as for misses
+    cmd = _cmd(2, os.path.join(tmp, "cache_w2"), ledger_path,
+               comp_cache) + [
+        "--listen", "127.0.0.1:0",
+        "--debug-bundle-dir", bundle_dir,
+    ]
+    with open(err_path, "w") as errf:
+        router = subprocess.Popen(
+            cmd, cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
+            stderr=errf, text=True,
+        )
+    try:
+        addr = None
+        deadline = time.time() + RUN_TIMEOUT_S
+        while time.time() < deadline:
+            text = open(err_path).read()
+            m = _TCP_RE.search(text)
+            if m:
+                addr = (m.group(1), int(m.group(2)))
+                break
+            if router.poll() is not None:
+                problems.append(
+                    f"fleet: router died during startup: {text[-800:]}"
+                )
+                return
+            time.sleep(0.25)
+        if addr is None:
+            problems.append("fleet: TCP front never came up")
+            return
+
+        sock = socket.create_connection(addr, timeout=30.0)
+        rf = sock.makefile("r", encoding="utf-8")
+        wf = sock.makefile("w", encoding="utf-8")
+        want = {json.loads(ln)["id"] for ln in lines}
+        for ln in lines:
+            wf.write(ln + "\n")
+        wf.flush()
+        docs: dict = {}
+        sock.settimeout(RUN_TIMEOUT_S)
+        while len(docs) < len(want):
+            doc = json.loads(rf.readline())
+            if doc.get("id") in want:
+                docs[doc["id"]] = doc
+        _compare("fleet", reference, docs, problems)
+
+        # batch settled — now the control plane, one line per kind
+        control: dict = {}
+        for kind in ("stats", "metrics", "dump_debug"):
+            wf.write(json.dumps({"id": f"cf-{kind}", "type": kind})
+                     + "\n")
+            wf.flush()
+            doc = json.loads(rf.readline())
+            if not doc.get("ok"):
+                problems.append(f"fleet: {kind} control line failed: "
+                                f"{doc.get('error')}")
+                return
+            control[kind] = doc[kind]
+        sock.close()
+
+        st = control["stats"]
+        if len(st.get("worker_stats") or {}) != 2:
+            problems.append(
+                "fleet: stats did not report both workers: "
+                f"{sorted(st.get('worker_stats') or {})}"
+            )
+        fleet_sub = (st.get("fleet", {}).get("executor", {})
+                     .get("submitted"))
+        per_sub = sum(
+            w.get("executor", {}).get("submitted", 0)
+            for w in (st.get("worker_stats") or {}).values()
+        )
+        if fleet_sub != per_sub or not per_sub:
+            problems.append(
+                f"fleet: stats fleet.executor.submitted {fleet_sub} "
+                f"!= sum of workers {per_sub}"
+            )
+
+        mx = control["metrics"]
+        sums: dict = {}
+        for name in ("service_submitted", "service_requests"):
+            merged = (mx.get("counters") or {}).get(name)
+            sums[name] = sum(
+                (w.get("counters") or {}).get(name, 0)
+                for w in (mx.get("workers") or {}).values()
+            )
+            if merged != sums[name] or not sums[name]:
+                problems.append(
+                    f"fleet: merged counter {name}={merged} != sum "
+                    f"of per-worker sections {sums[name]}"
+                )
+        want_line = (
+            "pluss_service_submitted_total "
+            f"{float(sums['service_submitted']):g}"
+        )
+        if want_line not in (mx.get("prometheus") or ""):
+            problems.append(
+                "fleet: merged prometheus exposition does not carry "
+                "the summed service_submitted"
+            )
+
+        dd = control["dump_debug"]
+        worker_bundles = {
+            wid: (sec or {}).get("bundle")
+            for wid, sec in (dd.get("workers") or {}).items()
+        }
+        if len(worker_bundles) != 2 or not all(
+            worker_bundles.values()
+        ):
+            problems.append(
+                f"fleet: dump_debug did not produce a bundle on "
+                f"every worker: {worker_bundles}"
+            )
+        if not dd.get("bundle"):
+            problems.append(
+                "fleet: dump_debug produced no router bundle"
+            )
+
+        router.send_signal(signal.SIGTERM)
+        try:
+            rc = router.wait(timeout=90.0)
+        except subprocess.TimeoutExpired:
+            problems.append("fleet: router did not drain on SIGTERM")
+            router.kill()
+            router.wait(timeout=10.0)
+            return
+        if rc != 0:
+            problems.append(
+                f"fleet: router exited {rc} after SIGTERM drain: "
+                f"{open(err_path).read()[-800:]}"
+            )
+
+        for path in [p for p in worker_bundles.values() if p] + [
+            dd.get("bundle")
+        ]:
+            if path and not os.path.exists(path):
+                problems.append(
+                    f"fleet: dump_debug bundle {path} missing on disk"
+                )
+
+        # the join + assembly leg: ledger rows alone reconstruct the
+        # fabric's view of every request
+        rows = []
+        with open(ledger_path) as f:
+            for ln in f:
+                if ln.strip():
+                    rows.append(json.loads(ln))
+        for v in check_ledger.check_trace_join(rows):
+            problems.append(f"fleet: {v}")
+        router_rows = [
+            r for r in rows
+            if r.get("kind") == "request"
+            and r.get("source") == "fabric.router"
+        ]
+        if len(router_rows) != len(lines):
+            problems.append(
+                f"fleet: {len(lines)} requests -> "
+                f"{len(router_rows)} router ledger rows"
+            )
+        traces = fleet.assemble_traces(rows)
+        unassembled = {
+            r.get("trace_id") for r in router_rows
+        } - set(traces)
+        if unassembled:
+            problems.append(
+                f"fleet: trace(s) did not assemble: {unassembled}"
+            )
+        # every EXECUTED request must join a worker track; coalesced
+        # duplicates legitimately ride the executing request's worker
+        # row, so the floor is the distinct-fingerprint count
+        with_worker = [
+            tid for tid, doc in traces.items()
+            if any(ev.get("pid") == 2 and ev.get("ph") == "X"
+                   for ev in doc["traceEvents"])
+        ]
+        n_fp = len({r.get("fingerprint") for r in router_rows})
+        if len(with_worker) < n_fp:
+            problems.append(
+                f"fleet: only {len(with_worker)} of {len(traces)} "
+                f"assembled traces carry a worker track "
+                f"(expected >= {n_fp} distinct fingerprints)"
+            )
+        print(f"check_fabric: fleet: {len(traces)} trace(s) "
+              f"assembled, merged metrics consistent, "
+              f"{len(worker_bundles)}+1 bundles")
+    finally:
+        if router.poll() is None:
+            router.kill()
+            router.wait(timeout=10.0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fabric CI gate: subprocess router+workers, "
         "1-vs-2-worker bit-identity, restart-stable sharding, "
-        "worker-kill re-dispatch, zero orphans"
+        "worker-kill re-dispatch, fleet telemetry, zero orphans"
     )
     ap.add_argument("--comp-cache",
                     default=os.path.join(REPO, ".jax_cache", "tests"),
@@ -402,6 +620,10 @@ def main(argv=None) -> int:
         check_kill_redispatch(lines, one, tmp, args.comp_cache,
                               problems)
         _no_orphans("kill", tmp, problems)
+
+        check_fleet_observability(lines, one, tmp, args.comp_cache,
+                                  problems)
+        _no_orphans("fleet", tmp, problems)
     except SystemExit:
         pass
     finally:

@@ -31,6 +31,16 @@ may sit at most one ring position deeper per recorded
 `worker_disconnect` re-dispatch hop in its degrade chain. A sharding
 violation means a router bug (or a mis-set --worker-id) broke
 fingerprint affinity, and fails the check like an invalid line.
+
+When the ledger also carries router rows (source `fabric.router`,
+written by a tracing-enabled router sharing the workers' ledger),
+--stats additionally validates the TRACE JOIN — every fabric worker
+row's trace_id must appear in some router row, i.e. trace propagation
+over the wire (service/fabric/wire.py `trace` blocks) actually reached
+the workers — and the aggregate gains the `fleet:` line (per-worker
+share of routed rows, wire and router-overhead p50/p95). The join
+check is vacuous on ledgers with no router rows (standalone serves,
+tracing disabled).
 """
 
 from __future__ import annotations
@@ -102,6 +112,39 @@ def check_worker_sharding(rows, ring_workers: int = 0) -> list[str]:
     return violations
 
 
+def check_trace_join(rows) -> list[str]:
+    """Trace-join violations across a fabric's shared ledger (empty =
+    clean). Applies only when router rows (source fabric.router) are
+    present: every worker request row (worker_id stamped, source
+    "service") must carry a trace_id the router also recorded —
+    proving the wire-level trace propagation, not just that both
+    sides wrote rows. Vacuous (always clean) on standalone or
+    tracing-off ledgers."""
+    from pluss_sampler_optimization_tpu.runtime.obs import ledger
+
+    router_tids = {
+        row.get("trace_id") for row in rows
+        if row.get("kind") == "request"
+        and row.get("source") == ledger.ROUTER_SOURCE
+        and row.get("trace_id")
+    }
+    if not router_tids:
+        return []
+    violations = []
+    for row in rows:
+        if (row.get("kind") != "request"
+                or row.get("worker_id") is None
+                or row.get("source") != "service"):
+            continue
+        tid = row.get("trace_id")
+        if tid not in router_tids:
+            violations.append(
+                f"worker {row['worker_id']} row trace_id "
+                f"{str(tid)[:16]} has no matching router row"
+            )
+    return violations
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("ledger", help="run ledger JSONL file")
@@ -166,6 +209,7 @@ def main(argv=None) -> int:
            if args.gc and n_bad else "")
     )
     shard_violations = 0
+    trace_violations = 0
     if args.stats:
         from pluss_sampler_optimization_tpu.runtime.obs import ledger
 
@@ -186,9 +230,23 @@ def main(argv=None) -> int:
                    if not violations
                    else f"{shard_violations} violation(s)")
             )
+        joins = check_trace_join(scan["valid"])
+        trace_violations = len(joins)
+        for v in joins:
+            print(f"{args.ledger}: TRACE: {v}", file=sys.stderr)
+        if any(
+            row.get("source") == ledger.ROUTER_SOURCE
+            for row in scan["valid"]
+        ):
+            print(
+                "trace join: "
+                + ("clean (every worker row joins a router row)"
+                   if not joins
+                   else f"{trace_violations} orphan worker row(s)")
+            )
     if args.gc:
         return 0
-    return 1 if (n_bad or shard_violations) else 0
+    return 1 if (n_bad or shard_violations or trace_violations) else 0
 
 
 if __name__ == "__main__":

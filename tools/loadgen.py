@@ -269,12 +269,21 @@ def connect_run(addr: str, requests: list, offsets: list[float],
     reader thread collects them concurrently with submission — the
     loop stays open exactly like the in-process path. Requests whose
     response never arrives inside timeout_s count as failed.
+
+    Send/receive times are stamped per request on THIS client's
+    clock, so the report also splits where time went: overhead_p50/
+    p95_s is client end-to-end minus the worker's self-reported
+    execute_s — i.e. routing + wire + queueing, everything the fabric
+    added on top of engine execution (None against servers that
+    predate the execute_s response field).
     """
     from pluss_sampler_optimization_tpu.service.fabric import wire
 
     host, port = wire.parse_hostport(addr)
     want = {r.id for r in requests}
     docs: dict = {}
+    sent: dict = {}
+    recv: dict = {}
     done = threading.Event()
     sock = socket.create_connection((host, port), timeout=timeout_s)
     rfile = sock.makefile("r", encoding="utf-8", newline="\n")
@@ -291,6 +300,7 @@ def connect_run(addr: str, requests: list, offsets: list[float],
                 except ValueError:
                     continue
                 if isinstance(doc, dict) and doc.get("id") in want:
+                    recv[doc["id"]] = time.perf_counter()
                     docs[doc["id"]] = doc
                     if len(docs) == len(want):
                         break
@@ -308,6 +318,7 @@ def connect_run(addr: str, requests: list, offsets: list[float],
             now = time.perf_counter() - t0
             if off > now:
                 time.sleep(off - now)
+            sent[req.id] = time.perf_counter()
             wfile.write(request_jsonl(req) + "\n")
             wfile.flush()
         done.wait(timeout=timeout_s)
@@ -349,6 +360,17 @@ def connect_run(addr: str, requests: list, offsets: list[float],
         report[f"latency_{name}_s"] = (
             round(obs_ledger._percentile(lats, q), 6) if lats
             else None
+        )
+    overheads = sorted(
+        (recv[rid] - sent[rid]) - float(d["execute_s"])
+        for rid, d in docs.items()
+        if d.get("ok") and d.get("execute_s") is not None
+        and rid in sent and rid in recv
+    )
+    for name, q in (("p50", 0.50), ("p95", 0.95)):
+        report[f"overhead_{name}_s"] = (
+            round(obs_ledger._percentile(overheads, q), 6)
+            if overheads else None
         )
     return report
 
