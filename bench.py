@@ -530,6 +530,103 @@ def overload_shedding_extra(timeout: float = 120.0) -> dict:
     }
 
 
+def progressive_precision_extra(model: str = "gemm", n: int = 32,
+                                ratio: float = 0.3, seed: int = 0,
+                                tolerance: float = 0.15) -> dict:
+    """Progressive-precision evidence: what the confidence-banded
+    round schedule (sampler/sampled.py::run_sampled_progressive)
+    buys and what it costs. Three runs of the same (model, n, ratio,
+    seed): the one-shot sampled engine (the static full-ratio
+    baseline), the full progressive schedule (tolerance 0 — must
+    land the SAME MRC digest, the bit-identity claim), and a
+    tolerance-stopped run recording samples-to-tolerance — how many
+    samples the early exit left unclassified once the bootstrap band
+    was narrow enough. main() records this as the
+    `progressive_precision` extra; tools/check_precision.py gates
+    the bit-identity and replay halves per seed."""
+    from pluss_sampler_optimization_tpu.config import (
+        MachineConfig, SamplerConfig,
+    )
+    from pluss_sampler_optimization_tpu.models import (
+        build as build_model,
+    )
+    from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
+    from pluss_sampler_optimization_tpu.runtime.cri import (
+        cri_distribute,
+    )
+    from pluss_sampler_optimization_tpu.runtime.obs import (
+        ledger as obs_ledger,
+    )
+    from pluss_sampler_optimization_tpu.sampler.sampled import (
+        run_sampled, run_sampled_progressive,
+    )
+
+    program = build_model(model, n)
+    machine = MachineConfig()
+    T = machine.thread_num
+
+    def digest(state):
+        return obs_ledger.mrc_digest(
+            aet_mrc(cri_distribute(state, T, T), machine)
+        )
+
+    t0 = time.perf_counter()
+    state_o, results_o = run_sampled(
+        program, machine, SamplerConfig(ratio=ratio, seed=seed)
+    )
+    wall_one = time.perf_counter() - t0
+    one_samples = int(sum(r.n_samples for r in results_o))
+    digest_one = digest(state_o)
+
+    t0 = time.perf_counter()
+    state_f, _results_f, info_f = run_sampled_progressive(
+        program, machine,
+        SamplerConfig(ratio=ratio, seed=seed, tolerance=0.0),
+    )
+    wall_full = time.perf_counter() - t0
+    digest_full = digest(state_f)
+
+    t0 = time.perf_counter()
+    state_t, results_t, info_t = run_sampled_progressive(
+        program, machine,
+        SamplerConfig(ratio=ratio, seed=seed, tolerance=tolerance),
+    )
+    wall_tol = time.perf_counter() - t0
+    tol_samples = int(sum(r.n_samples for r in results_t))
+
+    return {
+        "model": model, "n": n, "ratio": ratio, "seed": seed,
+        "tolerance": tolerance,
+        "one_shot": {
+            "samples": one_samples, "wall_s": round(wall_one, 4),
+            "mrc_digest": digest_one,
+        },
+        "full_schedule": {
+            "rounds": info_f["rounds"],
+            "band_width": round(info_f["band_width"], 6),
+            "wall_s": round(wall_full, 4),
+            "mrc_digest": digest_full,
+            "round_overhead_frac": round(
+                wall_full / max(1e-9, wall_one) - 1.0, 4
+            ),
+        },
+        "tolerance_stop": {
+            "rounds": info_t["rounds"],
+            "rounds_total": info_t["rounds_total"],
+            "band_width": round(info_t["band_width"], 6),
+            "converged": info_t["converged"],
+            "samples": tol_samples,
+            "wall_s": round(wall_tol, 4),
+            "mrc_digest": digest(state_t),
+        },
+        "digest_parity": digest_full == digest_one,
+        "stopped_early": info_t["rounds"] < info_t["rounds_total"],
+        "samples_saved_frac": round(
+            1.0 - tol_samples / max(1, one_samples), 4
+        ),
+    }
+
+
 def lock_witness_extra(timeout: float = 120.0) -> dict:
     """Lockdep-witness overhead on the serving path: the same
     deterministic request set served witness-off and witness-on
@@ -1629,6 +1726,19 @@ def main() -> int:
             ov.update(overload_shedding_extra())
         except Exception as e:  # never sink the headline metric
             ov["error"] = repr(e)
+
+    # Progressive precision: samples-to-tolerance vs the static
+    # full-ratio cost. One-shot baseline, the full progressive
+    # schedule (digest parity = the bit-identity claim in the
+    # evidence sidecar), and a tolerance-stopped run recording how
+    # many samples the confidence-band early exit saved.
+    if extras_budget_left("progressive_precision", extra):
+        pp: dict = {}
+        extra["progressive_precision"] = pp
+        try:
+            pp.update(progressive_precision_extra())
+        except Exception as e:  # never sink the headline metric
+            pp["error"] = repr(e)
 
     # Live-metrics registry overhead: the serve path enables the
     # rolling registry unconditionally, so its cost on the hot engine

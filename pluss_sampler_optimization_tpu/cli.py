@@ -207,9 +207,34 @@ def _run_engine(engine: str, program, machine, args):
             kw["kernel_backend"] = args.kernel_backend
         if args.pipeline_depth is not None:
             kw["pipeline_depth"] = args.pipeline_depth
+        progressive = any(
+            v is not None for v in (args.tolerance, args.max_rounds,
+                                    args.round_schedule)
+        )
+        if args.tolerance is not None:
+            kw["tolerance"] = args.tolerance
+        if args.max_rounds is not None:
+            kw["max_rounds"] = args.max_rounds
+        if args.round_schedule is not None:
+            kw["round_schedule"] = _parse_round_schedule(
+                args.round_schedule
+            )
         cfg = SamplerConfig(ratio=args.ratio, seed=args.seed, **kw)
         v2 = args.runtime == "v2"
-        if engine == "sampled":
+        if engine == "sampled" and progressive:
+            from .sampler.sampled import run_sampled_progressive
+
+            state, results, info = run_sampled_progressive(
+                program, machine, cfg, v2=v2,
+            )
+            print(
+                f"progressive: rounds "
+                f"{info['rounds']}/{info['rounds_total']}, band "
+                f"{info['band_width']:.6f}, converged "
+                f"{info['converged']}",
+                file=sys.stderr,
+            )
+        elif engine == "sampled":
             from .sampler.sampled import run_sampled
 
             state, results = run_sampled(
@@ -343,6 +368,23 @@ def main(argv=None) -> int:
                     "awaiting their device->host fetch before the "
                     "oldest is drained (config default: 4; forced "
                     "drains count as pipeline_stalls in telemetry)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="sampled engine: run progressively — rounds "
+                    "of increasing sample-stream prefixes — and stop "
+                    "early once the bootstrap MRC confidence band is "
+                    "narrower than this width (0 disables early stop "
+                    "but still streams per-round bands; a full "
+                    "schedule is bit-identical to the one-shot run). "
+                    "Out of the request fingerprint like --fuse-refs")
+    ap.add_argument("--max-rounds", type=int, default=None,
+                    help="progressive sampled engine: schedule length "
+                    "when --round-schedule is not given (geometric "
+                    "doubling 1/2^(R-1)..1; default 4)")
+    ap.add_argument("--round-schedule", default=None,
+                    help="progressive sampled engine: explicit "
+                    "comma-separated increasing fractions of the "
+                    "final sample count, ending at 1.0 — e.g. "
+                    "0.25,0.5,1.0")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--tid", type=int, default=0, help="trace mode thread")
     ap.add_argument("--min-reuse", type=int, default=512,
@@ -1261,6 +1303,18 @@ def _stats(args) -> int:
     return 0
 
 
+def _parse_round_schedule(spec: str) -> tuple:
+    """"0.25,0.5,1.0" -> (0.25, 0.5, 1.0); validation happens where
+    the schedule is resolved (sampler/confidence.py)."""
+    try:
+        return tuple(float(f) for f in spec.split(",") if f.strip())
+    except ValueError:
+        raise SystemExit(
+            f"--round-schedule wants comma-separated floats, got "
+            f"{spec!r}"
+        )
+
+
 def _request_from_args(args, engine):
     from .service import AnalysisRequest
 
@@ -1272,6 +1326,11 @@ def _request_from_args(args, engine):
         kernel_backend=args.kernel_backend,
         program=getattr(args, "_program_doc", None),
         deadline_s=args.deadline_s,
+        tolerance=args.tolerance, max_rounds=args.max_rounds,
+        round_schedule=(
+            _parse_round_schedule(args.round_schedule)
+            if args.round_schedule is not None else None
+        ),
     )
 
 

@@ -155,6 +155,23 @@ class SamplerConfig:
     # host/device overlap at that memory cost. A forced drain counts
     # as `pipeline_stalls` in telemetry.
     pipeline_depth: int = 4
+    # Progressive-precision knobs (sampler/sampled.py::
+    # run_sampled_progressive + sampler/confidence.py). The driver
+    # splits the FINAL ratio's per-ref sample stream into prefix
+    # rounds; after every round a seeded bootstrap over the per-ref
+    # round sub-histograms yields an MRC confidence band. tolerance:
+    # stop early once the band's max width is <= this (None = run the
+    # whole schedule). round_schedule: increasing fractions of the
+    # final per-ref sample count, last entry 1.0 (None = geometric
+    # doubling over max_rounds). max_rounds: schedule length when
+    # round_schedule is None (None = DEFAULT_MAX_ROUNDS). Because the
+    # rounds are prefix slices of the SAME seed-derived stream, a run
+    # that completes its schedule folds to MRC bytes bit-identical to
+    # the one-shot sampled run at cfg.ratio — so, like fuse_refs/
+    # pipeline_depth, these knobs stay OUT of the request fingerprint.
+    tolerance: float | None = None
+    max_rounds: int | None = None
+    round_schedule: tuple | None = None
 
     def num_samples(self, trips) -> int:
         import math
@@ -440,7 +457,7 @@ class FabricConfig:
 # the runtime layer.
 FAULT_SITES = ("engine_execute", "replica_dispatch", "cache_load",
                "cache_store", "serve_line", "worker_conn",
-               "worker_exec")
+               "worker_exec", "round_exec")
 FAULT_KINDS = ("raise", "latency", "hang", "corrupt", "compile_failure",
                "disconnect")
 

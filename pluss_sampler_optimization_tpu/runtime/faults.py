@@ -12,6 +12,11 @@ Every hot path of the serving stack carries a NAMED injection site:
                       router->worker link
     worker_exec       service/fabric/worker.py — one request frame
                       received by a worker
+    round_exec        sampler/sampled.py::run_sampled_progressive —
+                      one progressive-precision round about to
+                      execute (latency/hang here overruns a request
+                      deadline mid-run, forcing the deterministic
+                      partial_final path tools/check_chaos.py pins)
 
 With no injector installed (the default), every site is a two-opcode
 no-op — `fire()` returns on a single module-global None check, so the

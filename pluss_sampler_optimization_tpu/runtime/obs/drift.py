@@ -16,10 +16,19 @@ default thresholds are calibrated against the measured seed-0 CPU
 values at the default audit configs (gemm/mvt n=48, ratio 0.3:
 max_abs ≈ 0.135 / 0.050) with ~2.5x headroom, so the gate trips on a
 real sampler regression, not on the known sampling noise floor.
+
+Progressive-precision audits carry their OWN noise floor: a bootstrap
+confidence band (sampler/confidence.py) around the sampled curve.
+When an audit (or a replayed ledger row) carries `band_width`, the
+breach verdict is `max_abs_delta > band_width` — the sampled curve
+left its statistical uncertainty — instead of the global calibrated
+thresholds. Band-less rows (every pre-progressive row) keep the
+global path, so an old ledger re-evaluates byte-for-byte.
 """
 
 from __future__ import annotations
 
+import math
 import time
 
 from .. import telemetry
@@ -67,6 +76,35 @@ def mrc_drift_metrics(mrc_exact, mrc_sampled) -> dict:
     }
 
 
+def breach_verdict(metrics: dict, thresholds: dict | None = None,
+                   band_width=None) -> bool:
+    """Whether one audit's error metrics constitute a breach.
+
+    With a finite non-negative `band_width` (a progressive-precision
+    run's bootstrap confidence band), the verdict is per-row:
+    max_abs_delta beyond the band means the error exceeds what the
+    band attributes to sampling noise. Otherwise — band-less rows,
+    one-shot audits, and every row written before bands existed — the
+    global DRIFT_THRESHOLDS apply unchanged (the migration contract
+    tests/test_precision.py pins)."""
+    if (isinstance(band_width, (int, float))
+            and not isinstance(band_width, bool)
+            and math.isfinite(float(band_width))
+            and float(band_width) >= 0.0):
+        return float(metrics["max_abs_delta"]) > float(band_width)
+    thresholds = thresholds or DRIFT_THRESHOLDS
+    return any(
+        metrics[key] > limit for key, limit in thresholds.items()
+    )
+
+
+def row_breach(row: dict, thresholds: dict | None = None) -> bool:
+    """Re-evaluate a ledger drift row's breach verdict: band-aware
+    when the row carries `band_width`, global-threshold otherwise."""
+    return breach_verdict(row, thresholds=thresholds,
+                          band_width=row.get("band_width"))
+
+
 def drift_audit(
     model: str,
     n: int = DEFAULT_AUDIT_N,
@@ -76,6 +114,7 @@ def drift_audit(
     thresholds: dict | None = None,
     ledger_path: str | None = None,
     source: str = "drift",
+    band_width: float | None = None,
 ) -> dict:
     """One sampled-vs-exact audit -> the ledger "drift" row (appended
     to `ledger_path` when given, returned either way).
@@ -117,9 +156,8 @@ def drift_audit(
             )
             mrc_sampled = aet_mrc(cri_distribute(state, T, T), machine)
     metrics = mrc_drift_metrics(mrc_exact, mrc_sampled)
-    breach = any(
-        metrics[key] > limit for key, limit in thresholds.items()
-    )
+    breach = breach_verdict(metrics, thresholds,
+                            band_width=band_width)
     row = {
         "kind": "drift",
         "source": source,
@@ -137,6 +175,8 @@ def drift_audit(
         "mrc_digest_sampled": obs_ledger.mrc_digest(mrc_sampled),
         **metrics,
     }
+    if band_width is not None:
+        row["band_width"] = round(float(band_width), 6)
     # static per-model priors (analysis/bounds.py): the facts the
     # audit row lets an offline reader sanity-check BOTH curves
     # against (compulsory-miss floor, exact cold footprint) — and the

@@ -226,6 +226,18 @@ def validate_row(row) -> list[str]:
                 errors.append(f"'{flag}' must be a boolean")
         if "retries" in row:
             need_num("retries", nullable=True)
+        # progressive precision (service/executor.py): rounds actually
+        # run, the final bootstrap confidence-band width, and whether
+        # the band converged (vs a deadline partial_final). All
+        # optional — non-progressive rows keep their exact bytes
+        if "rounds" in row:
+            need_num("rounds", nullable=True)
+        if "band_width" in row:
+            need_num("band_width", nullable=True)
+        if "converged" in row and not isinstance(
+            row["converged"], bool
+        ):
+            errors.append("'converged' must be a boolean")
         # per-request utilization attribution block
         # (runtime/obs/attribution.py): optional — rows written
         # without the attribution layer keep their exact shape
@@ -240,6 +252,11 @@ def validate_row(row) -> list[str]:
         need_num("mean_abs_delta")
         if not isinstance(row.get("breach"), bool):
             errors.append("'breach' must be a boolean")
+        # progressive-precision audits may judge against their own
+        # confidence band (runtime/obs/drift.py::breach_verdict) —
+        # optional, band-less rows stay valid unchanged
+        if "band_width" in row:
+            need_num("band_width", nullable=True)
     elif kind == "bench":
         need_str("metric")
         need_num("value")

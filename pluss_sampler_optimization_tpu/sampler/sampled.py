@@ -1873,6 +1873,243 @@ def run_sampled(
     return state, results
 
 
+def _stream_order(keys: np.ndarray, seed: int) -> np.ndarray:
+    """Deterministic uniform round-assignment order for one ref's
+    drawn key set: argsort by a splitmix64 hash of (key, seed).
+
+    draw_sample_keys returns the sample SET sorted by key (np.unique),
+    so a plain prefix would be the smallest iteration points — a
+    biased subsample no confidence band could speak for. Hashing makes
+    every prefix of the reordered stream an (exchangeable) uniform
+    subset of the full set, while the UNION over all rounds is the set
+    itself — which is all the final-round bit-identity needs (every
+    consumer of the folded histograms iterates in sorted-key order,
+    and integer-count float accumulation is exact, so processing
+    order never reaches the MRC bytes). Pure integer arithmetic:
+    replays exactly from (keys, seed) on every platform."""
+    x = keys.astype(np.uint64) + np.uint64(seed & ((1 << 64) - 1))
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> np.uint64(31))
+    # lexsort's final key (the hash) is primary; ties (hash collisions)
+    # break on the raw key so the order is total and deterministic
+    return np.lexsort((keys, x))
+
+
+def _classify_slice(nt, kernel, keys: np.ndarray, batch: int, ph,
+                    rxv, cap_box: list):
+    """Classify one contiguous slice of a ref's (reordered) key stream
+    through the plain per-ref kernel, mirroring the serial runner's
+    chunk/drain/regrow loop, into a fresh sub-histogram block.
+    `cap_box` is the run-wide mutable [capacity] so a regrow sticks
+    for later slices. Returns (noshare, share, cold)."""
+    noshare: dict[int, float] = {}
+    share: dict[int, dict[int, float]] = {}
+    cold = 0.0
+    n = len(keys)
+    for s0 in range(0, n, batch):
+        chunk, n_valid = pad_keys(keys[s0:s0 + batch], 1, total=batch)
+        chunk = _place(chunk)
+        telemetry.count("dispatches")
+        with telemetry.span("dispatch", form="progressive"):
+            out = kernel(chunk, n_valid, ph, nt.vals, rxv, cap_box[0])
+        with telemetry.span("fetch"):
+            pk, pc, n_unique, c = telemetry.record_fetch(
+                jax.device_get(out)
+            )
+        while int(n_unique) > cap_box[0]:
+            cap_box[0] = max(cap_box[0] * 4, int(n_unique))
+            telemetry.count("capacity_regrows")
+            with telemetry.span("fetch", regrow=True):
+                pk, pc, n_unique, c = telemetry.record_fetch(
+                    jax.device_get(kernel(
+                        chunk, n_valid, ph, nt.vals, rxv, cap_box[0]
+                    ))
+                )
+        cold += float(c)
+        with telemetry.span("merge"):
+            decode_pairs(pk, pc, noshare, share)
+    return noshare, share, cold
+
+
+def _sum_blocks(blocks) -> tuple:
+    """Union of sub-histogram blocks (sorted-key accumulation; counts
+    are integers, so the float sums are exact and order-free)."""
+    noshare: dict[int, float] = {}
+    share: dict[int, dict[int, float]] = {}
+    cold = 0.0
+    for ns, sh, c in blocks:
+        for k in sorted(ns):
+            noshare[k] = noshare.get(k, 0.0) + ns[k]
+        for ratio in sorted(sh):
+            d = share.setdefault(ratio, {})
+            h = sh[ratio]
+            for k in sorted(h):
+                d[k] = d.get(k, 0.0) + h[k]
+        cold += c
+    return noshare, share, cold
+
+
+def run_sampled_progressive(
+    program: Program,
+    machine: MachineConfig,
+    cfg: SamplerConfig | None = None,
+    v2: bool = False,
+    *,
+    batch: int | None = None,
+    capacity: int = DEFAULT_CAPACITY,
+    on_round=None,
+    should_stop=None,
+    fault_key=None,
+) -> tuple[PRIState, list[SampledRefResult], dict]:
+    """Round-based sampled engine with confidence-banded early exit.
+
+    Each ref draws its FULL final-ratio sample stream once, with the
+    one-shot host-draw convention (numpy PCG, seed = cfg.seed *
+    1000003 + row index) — so the stream IS the one-shot sample set —
+    then classifies it across rounds of increasing prefixes of a
+    seeded reorder (_stream_order) of that stream. Per round, each
+    ref's new slice lands in SUB_BLOCKS_PER_ROUND independent
+    sub-histogram blocks; sampler/confidence.py bootstraps an MRC
+    band over them between rounds. The run stops early when the band
+    width drops under cfg.tolerance, or at a round boundary when
+    `should_stop()` (the executor's request-deadline probe) returns
+    True; either way the cumulative union state is returned. A run
+    that completes the whole schedule folds the exact one-shot sample
+    set, so its PRIState/MRC is bit-identical to run_sampled at the
+    same (ratio, seed) on the host draw path.
+
+    `on_round(info)` fires after every completed round with the round
+    index, cumulative (state, results), interim MRC, and the
+    monotone-clamped band width — the hook the serving layer streams
+    `partial` frames from. `fault_key` keys the `round_exec` chaos
+    site (runtime/faults.py) fired at each round start.
+
+    Returns (state, results, info) with info = {"rounds" completed,
+    "rounds_total", "band_width", "converged", "stopped"
+    (None | "converged" | "deadline")}.
+    """
+    from ..runtime import faults
+    from . import confidence
+
+    cfg = cfg or SamplerConfig()
+    _apply_compilation_cache(cfg)
+    if batch is None:
+        batch = default_batch()
+    if _use_device_draw(cfg):
+        # the progressive stream is the HOST draw stream: prefix
+        # extension needs the whole set materialized host-side, and
+        # the bit-identity anchor is the host-path one-shot run
+        telemetry.warn_once(
+            "progressive_host_draw",
+            "progressive sampling always draws on the host; "
+            "device_draw ignored for this run",
+        )
+    schedule = confidence.resolve_schedule(cfg)
+    n_rounds = len(schedule)
+    tol = getattr(cfg, "tolerance", None)
+    trace, rows = _program_kernels(program, machine)
+    cap_box = [capacity]
+    refs = []
+    with telemetry.span("engine", engine="sampled"):
+        for idx, (k, ri, ks, sig) in enumerate(rows):
+            nt = trace.nests[k]
+            with telemetry.span("draw", where="host"):
+                keys_all, highs = draw_sample_keys(
+                    nt, ri, cfg, seed=cfg.seed * 1000003 + idx
+                )
+            order = _stream_order(keys_all, cfg.seed * 1000003 + idx)
+            refs.append({
+                "nt": nt,
+                "name": nt.tables.ref_names[ri],
+                "kernel": ks["plain"],
+                "keys": keys_all[order],
+                "ph": _pad_highs(highs),
+                "rxv": np.int64(ri),
+                "counts": confidence.round_counts(
+                    len(keys_all), schedule
+                ),
+            })
+        blocks: list[list] = [[] for _ in refs]
+        state = None
+        results: list[SampledRefResult] = []
+        band_width = None
+        stopped = None
+        done = 0
+        for r in range(n_rounds):
+            # chaos site: one occurrence per (request, round); a
+            # latency/hang here overruns the deadline the boundary
+            # check below observes
+            faults.fire("round_exec", key=fault_key, round=r,
+                        model=program.name)
+            if r > 0 and should_stop is not None and should_stop():
+                stopped = "deadline"
+                break
+            telemetry.count("progressive_rounds")
+            for ref, ref_blocks in zip(refs, blocks):
+                lo = 0 if r == 0 else ref["counts"][r - 1]
+                hi = ref["counts"][r]
+                for a, b in confidence.block_bounds(lo, hi):
+                    ref_blocks.append(_classify_slice(
+                        ref["nt"], ref["kernel"], ref["keys"][a:b],
+                        batch, ref["ph"], ref["rxv"], cap_box,
+                    ))
+            done = r + 1
+            results = [
+                SampledRefResult(
+                    name=ref["name"], noshare=ns, share=sh, cold=cold,
+                    n_samples=ref["counts"][r],
+                )
+                for ref, (ns, sh, cold) in zip(
+                    refs, (_sum_blocks(rb) for rb in blocks)
+                )
+            ]
+            with telemetry.span("merge", stage="fold_results"):
+                state = fold_results(results, machine.thread_num, v2)
+            raw = confidence.bootstrap_band(
+                blocks, machine, seed=cfg.seed, round_idx=r, v2=v2,
+            )
+            # monotone non-widening by construction: more samples
+            # never REPORT more uncertainty than an earlier round did
+            band_width = (
+                raw if band_width is None else min(band_width, raw)
+            )
+            early = (
+                tol is not None and band_width < tol
+                and r < n_rounds - 1
+            )
+            if on_round is not None:
+                on_round({
+                    "round": done,
+                    "rounds_total": n_rounds,
+                    "band_width": band_width,
+                    "converged": early or done == n_rounds,
+                    "state": state,
+                    "results": results,
+                    "mrc": confidence.mrc_from_state(state, machine),
+                })
+            if early:
+                stopped = "converged"
+                break
+    converged = stopped == "converged" or done == n_rounds
+    telemetry.gauge("progressive_band_width",
+                    band_width if band_width is not None else -1.0)
+    if state is None:
+        # should_stop before any round completed — nothing to return;
+        # the caller treats this like any engine failure
+        raise RuntimeError(
+            "progressive run stopped before its first round completed"
+        )
+    return state, results, {
+        "rounds": done,
+        "rounds_total": n_rounds,
+        "band_width": band_width,
+        "converged": converged,
+        "stopped": stopped,
+    }
+
+
 def sampled_outputs_multi(
     jobs, batch: int | None = None, capacity: int = DEFAULT_CAPACITY
 ) -> list[list[SampledRefResult]]:

@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import re
+import threading
 import time
 from concurrent.futures import CancelledError
 from typing import IO
@@ -62,6 +63,7 @@ from .executor import (
     SERVICE_ENGINES,
     RequestExecutor,
     default_runner,
+    progressive_requested,
 )
 from .fingerprint import request_fingerprint
 
@@ -119,6 +121,18 @@ class AnalysisRequest:
     # PRIStates (pinned by tests/test_pallas.py), so it too must stay
     # out of the fingerprint
     kernel_backend: str | None = None
+    # Progressive-precision knobs (sampled engine; any one set opts
+    # into the round-based driver): stop early once the bootstrap MRC
+    # band is narrower than `tolerance`; `max_rounds`/`round_schedule`
+    # shape the round ladder (sampler/confidence.py). Like fuse_refs
+    # these stay OUT of params()/the fingerprint: a converged
+    # progressive run is bit-identical to the one-shot sampled result
+    # at the final ratio (and a deadline-truncated partial_final is
+    # degraded, hence never cached), so the cached record answers
+    # every knob setting.
+    tolerance: float | None = None
+    max_rounds: int | None = None
+    round_schedule: list | None = None
     # Inline frontend document (frontend/schema.py) — the
     # "MRC-as-a-service" path. Mutually exclusive with addressing a
     # registry model: when set, `model` is the CUSTOM_MODEL sentinel
@@ -155,6 +169,38 @@ class AnalysisRequest:
                 f"unknown kernel_backend {self.kernel_backend!r} "
                 "(have auto, xla, pallas, native)"
             )
+        if self.tolerance is not None and (
+            not isinstance(self.tolerance, (int, float))
+            or isinstance(self.tolerance, bool)
+            or self.tolerance < 0
+        ):
+            raise ValueError("tolerance must be a non-negative number")
+        if self.max_rounds is not None and (
+            not isinstance(self.max_rounds, int)
+            or isinstance(self.max_rounds, bool)
+            or self.max_rounds < 1
+        ):
+            raise ValueError("max_rounds must be a positive integer")
+        if self.round_schedule is not None:
+            sched = self.round_schedule
+            ok = (
+                isinstance(sched, (list, tuple)) and len(sched) > 0
+                and all(
+                    isinstance(f, (int, float))
+                    and not isinstance(f, bool) for f in sched
+                )
+            )
+            if ok:
+                fr = [float(f) for f in sched]
+                ok = (
+                    fr[0] > 0.0 and fr[-1] == 1.0
+                    and all(b > a for a, b in zip(fr, fr[1:]))
+                )
+            if not ok:
+                raise ValueError(
+                    "round_schedule must be a strictly increasing "
+                    "list of fractions in (0, 1] ending at 1.0"
+                )
         if self.program is not None:
             if not isinstance(self.program, dict):
                 raise ValueError("'program' must be a JSON object")
@@ -220,6 +266,12 @@ class AnalysisRequest:
             # (store bytes pinned); custom records embed the document
             # so warm_from_ledger can replay them
             d.pop("program")
+        for k in ("tolerance", "max_rounds", "round_schedule"):
+            # unset progressive knobs are dropped the same way, so
+            # every pre-progressive request keeps its exact payload
+            # (and stored-record) bytes
+            if d.get(k) is None:
+                d.pop(k)
         return d
 
     def fingerprint(self, program: Program | None = None) -> str:
@@ -284,6 +336,16 @@ class AnalysisResponse:
     # reports exactly that)
     queue_s: float | None = None
     execute_s: float | None = None
+    # progressive-precision outcome (serving metadata): rounds the
+    # driver completed, the tightest confidence-band width reached,
+    # and whether the run converged (band under tolerance / full
+    # schedule). partial_final marks a deadline-truncated answer —
+    # served at the band above, recorded as a precision:* degrade
+    # hop, never cached.
+    rounds: int | None = None
+    band_width: float | None = None
+    converged: bool | None = None
+    partial_final: bool = False
 
     def to_jsonl_dict(self) -> dict:
         """The wire form `serve` emits: compact — the MRC ships in the
@@ -321,6 +383,14 @@ class AnalysisResponse:
             d["queue_s"] = self.queue_s
         if self.execute_s is not None:
             d["execute_s"] = self.execute_s
+        if self.rounds is not None:
+            d["rounds"] = self.rounds
+        if self.band_width is not None:
+            d["band_width"] = self.band_width
+        if self.converged is not None:
+            d["converged"] = self.converged
+        if self.partial_final:
+            d["partial_final"] = True
         if self.mrc is not None:
             d["mrc_len"] = int(len(self.mrc))
             d["mrc_lines"] = report.mrc_lines(self.mrc, header=False)
@@ -356,6 +426,10 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
             hedged=bool(outcome.get("hedged")),
             queue_s=outcome.get("queue_s"),
             execute_s=outcome.get("execute_s"),
+            rounds=outcome.get("rounds"),
+            band_width=outcome.get("band_width"),
+            converged=outcome.get("converged"),
+            partial_final=bool(outcome.get("partial_final")),
         )
     return AnalysisResponse(
         id=request.id,
@@ -382,6 +456,10 @@ def _response_from_outcome(request: AnalysisRequest, fingerprint: str,
         hedged=bool(outcome.get("hedged")),
         queue_s=outcome.get("queue_s"),
         execute_s=outcome.get("execute_s"),
+        rounds=outcome.get("rounds"),
+        band_width=outcome.get("band_width"),
+        converged=outcome.get("converged"),
+        partial_final=bool(outcome.get("partial_final")),
     )
 
 
@@ -676,11 +754,16 @@ class AnalysisService:
         except Exception:
             self.executor._count("ledger_write_failed")
 
-    def submit(self, request: AnalysisRequest) -> AnalysisTicket:
+    def submit(self, request: AnalysisRequest,
+               on_partial=None) -> AnalysisTicket:
         """Validate, preflight, fingerprint, and schedule (or join) a
         request. Raises ValueError/KeyError for malformed requests
         (PreflightError for invalid IR) — `serve` turns those into
-        per-line error responses."""
+        per-line error responses.
+
+        `on_partial` (progressive-precision requests only) receives
+        one interim-round doc per completed round of the (possibly
+        shared) execution; see RequestExecutor.submit."""
         if request.program is not None:
             from ..frontend.parse import FrontendError
 
@@ -703,7 +786,7 @@ class AnalysisService:
         fp = request.fingerprint(program)
         fut = self.executor.submit(
             request, program, request.machine(), fp,
-            preflight=preflight,
+            preflight=preflight, on_partial=on_partial,
         )
         return AnalysisTicket(request=request, fingerprint=fp,
                               future=fut)
@@ -799,10 +882,36 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
     submitted — finished results normally, queued-then-cancelled work
     with structured `shed: true` responses. Every submitted request
     resolves exactly once either way.
+
+    Progressive-precision requests (tolerance / max_rounds /
+    round_schedule set) additionally STREAM one `"partial": true` doc
+    per completed round — `{"id", "partial": true, "round",
+    "rounds_total", "band_width", "converged", "mrc_digest",
+    "mrc_lines", ...}` — interleaved ahead of the in-order final
+    responses (all writes share one lock, so lines never tear). The
+    final response for such a request carries `rounds`/`band_width`/
+    `converged`, plus `partial_final: true` with a `precision:*`
+    degrade hop when its deadline expired mid-schedule.
     """
     # each entry: {"line", "id", and one of "ticket"+"request" |
     # "control" | "error"}
     entries: list[dict] = []
+    # partial frames are written from executor threads while this
+    # thread is still reading/awaiting: one lock serializes every
+    # out_stream write
+    wlock = threading.Lock()
+
+    def _write(doc: dict) -> None:
+        with wlock:
+            out_stream.write(json.dumps(doc) + "\n")
+            out_stream.flush()
+
+    def _partial_writer(req_id):
+        def cb(doc: dict) -> None:
+            msg = dict(doc)
+            msg["id"] = req_id
+            _write(msg)
+        return cb
     try:
         for line_no, line in enumerate(in_stream, start=1):
             line = line.strip()
@@ -879,7 +988,10 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
                 continue
             try:
                 request = parse_request_line(line)
-                entry["ticket"] = service.submit(request)
+                cb = None
+                if progressive_requested(request):
+                    cb = _partial_writer(request.id)
+                entry["ticket"] = service.submit(request, on_partial=cb)
                 entry["request"] = request
             except Exception as e:
                 entry["error"] = _error_msg(e)
@@ -968,6 +1080,5 @@ def serve_jsonl(service: AnalysisService, in_stream: IO,
                 doc["diagnostics"] = entry["diagnostics"]
             if entry.get("shed"):
                 doc["shed"] = True
-        out_stream.write(json.dumps(doc) + "\n")
-        out_stream.flush()
+        _write(doc)
     return failures

@@ -193,7 +193,25 @@ def sampler_config(request) -> SamplerConfig:
         kw["pipeline_depth"] = request.pipeline_depth
     if getattr(request, "kernel_backend", None) is not None:
         kw["kernel_backend"] = request.kernel_backend
+    if getattr(request, "tolerance", None) is not None:
+        kw["tolerance"] = request.tolerance
+    if getattr(request, "max_rounds", None) is not None:
+        kw["max_rounds"] = request.max_rounds
+    if getattr(request, "round_schedule", None) is not None:
+        kw["round_schedule"] = tuple(request.round_schedule)
     return SamplerConfig(ratio=request.ratio, seed=request.seed, **kw)
+
+
+def progressive_requested(request) -> bool:
+    """Whether this request opted into the progressive-precision
+    driver: any of the three knobs set on a sampled request. Like
+    fuse_refs, the knobs stay out of the fingerprint — a converged
+    progressive run is bit-identical to the one-shot result at the
+    final ratio, so the cached record answers both forms."""
+    return request.engine == "sampled" and any(
+        getattr(request, k, None) is not None
+        for k in ("tolerance", "max_rounds", "round_schedule")
+    )
 
 
 def _sampled_namespace(state, results):
@@ -511,6 +529,11 @@ class RequestExecutor:
         # ledger aggregate reproduces the live submitted/coalesced
         # counters exactly
         self._coalesced_by_fp = collections.Counter()
+        # progressive-precision partial-frame subscribers per in-flight
+        # fingerprint: every submit (executor AND coalesced joiners)
+        # may register a callback; the executing round loop fires all
+        # of them after each completed round
+        self._partial_subs: dict[str, list] = {}
         # batching observability for stats(): per-batch member counts
         # and cold (cache-miss) latencies batched vs solo, bounded so a
         # long-lived service cannot grow them without limit
@@ -549,7 +572,9 @@ class RequestExecutor:
                     "frontend_rejected", "race_warnings",
                     "shed", "retried", "hedged", "hedge_wins",
                     "hedge_cancelled", "breaker_opened",
-                    "breaker_reclosed", "breaker_open_skips"):
+                    "breaker_reclosed", "breaker_open_skips",
+                    "partial_final", "progressive_converged",
+                    "partials_emitted"):
             out.setdefault(key, 0)
         active = out.pop("active")
         out["in_flight"] = inflight
@@ -629,6 +654,10 @@ class RequestExecutor:
         "breaker_opened": "service_breaker_opened",
         "breaker_reclosed": "service_breaker_reclosed",
         "breaker_open_skips": "service_breaker_open_skips",
+        "partial_final": "service_partial_final",
+        "progressive_converged": "service_progressive_converged",
+        "partials_emitted": "service_partials_emitted",
+        "partial_emit_failed": "service_partial_emit_failed",
     }
 
     def _count(self, key: str, inc: int = 1) -> None:
@@ -642,7 +671,8 @@ class RequestExecutor:
 
     def submit(self, request, program: Program,
                machine: MachineConfig, fingerprint: str,
-               preflight: dict | None = None) -> Future:
+               preflight: dict | None = None,
+               on_partial=None) -> Future:
         """Schedule (or join) the execution for one fingerprint.
 
         The returned future resolves to the full response dict (record
@@ -652,7 +682,13 @@ class RequestExecutor:
         service's static-analysis summary (verdict/races); it rides
         the outcome into the response and the ledger row. Coalesced
         joiners share the executing request's summary — same
-        fingerprint, same IR, same verdict."""
+        fingerprint, same IR, same verdict.
+
+        `on_partial` (progressive-precision requests) is called with
+        one interim-result doc per completed round, from the executing
+        thread; coalesced joiners register their own callback on the
+        shared execution, so every subscriber streams the same
+        rounds."""
         telemetry.count("service_requests")
         telemetry.count("service_submitted")
         if getattr(request, "trace_id", None) is None:
@@ -677,6 +713,10 @@ class RequestExecutor:
                 # remembered per fingerprint so the row can report how
                 # many submissions it answered
                 self._coalesced_by_fp[fingerprint] += 1
+                if on_partial is not None:
+                    self._partial_subs.setdefault(
+                        fingerprint, []
+                    ).append(on_partial)
             else:
                 # admission gate — AFTER the coalesce join (joining an
                 # in-flight execution costs nothing, so it is never
@@ -711,6 +751,12 @@ class RequestExecutor:
             # the first critical section, so an identical fingerprint
             # may have landed in between
             coalesced = self._inflight.get(fingerprint)
+            if on_partial is not None and (
+                coalesced is not None or not batchable
+            ):
+                self._partial_subs.setdefault(
+                    fingerprint, []
+                ).append(on_partial)
             if coalesced is not None:
                 self._stats["coalesced"] += 1
                 self._coalesced_by_fp[fingerprint] += 1
@@ -748,6 +794,7 @@ class RequestExecutor:
         def _done(_f, fp=fingerprint):
             with self._lock:
                 self._inflight.pop(fp, None)
+                self._partial_subs.pop(fp, None)
                 depth = len(self._inflight)
             telemetry.gauge("service_queue_depth", depth)
 
@@ -764,8 +811,11 @@ class RequestExecutor:
         """The compatibility predicate: which requests may share a
         batched execution. Today exactly the sampled engine — the only
         one with a multi-job runner; kernel-signature bucketing makes
-        any mix of models/N/configs mergeable within it."""
-        return request.engine == "sampled"
+        any mix of models/N/configs mergeable within it. Progressive
+        requests run their own round loop (deadline checks and partial
+        streaming between rounds), so they always execute solo."""
+        return (request.engine == "sampled"
+                and not progressive_requested(request))
 
     def _admission_limit(self, priority: str) -> int:
         """Queue slots this priority class may fill before shedding
@@ -1002,6 +1052,18 @@ class RequestExecutor:
             "retries": meta["retries"],
             "hedged": meta["hedged"],
         }
+        prog = meta.get("progressive")
+        if prog is not None:
+            # progressive-precision outcome fields (schema-v2
+            # optional): rounds completed, tightest band reached,
+            # whether the run converged; partial_final marks the
+            # deadline-truncated form (already a precision:* degrade
+            # hop above, so it was kept out of the cache)
+            outcome["rounds"] = prog["rounds"]
+            outcome["band_width"] = prog["band_width"]
+            outcome["converged"] = prog["converged"]
+            if prog.get("partial_final"):
+                outcome["partial_final"] = True
         self._attribute_utilization(outcome, compiles0,
                                     fetch_s=fetch_s)
         self._observe_stages(outcome, queue_s=queue_s,
@@ -1474,6 +1536,18 @@ class RequestExecutor:
             row["hedged"] = True
         if outcome.get("retries"):
             row["retries"] = int(outcome["retries"])
+        # schema-v2 progressive-precision columns: stamped only for
+        # progressive executions, so every other row keeps its exact
+        # pre-progressive bytes. band_width is finite by the time a
+        # round has completed; guard anyway so a ledger row can never
+        # carry a non-JSON float
+        if outcome.get("rounds") is not None:
+            row["rounds"] = int(outcome["rounds"])
+        bw = outcome.get("band_width")
+        if bw is not None and math.isfinite(float(bw)):
+            row["band_width"] = round(float(bw), 6)
+        if outcome.get("converged") is not None:
+            row["converged"] = bool(outcome["converged"])
         for stage in ("queue_s", "batch_wait_s", "execute_s"):
             v = outcome.get(stage)
             if v is not None:
@@ -1512,6 +1586,108 @@ class RequestExecutor:
                 self._breakers[engine] = br
             return br
 
+    def _fire_partial(self, fingerprint: str, doc: dict) -> None:
+        """Deliver one interim-round doc to every partial subscriber
+        of this fingerprint (executor + coalesced joiners). A
+        subscriber blow-up is ITS problem — counted, never allowed to
+        sink the executing round loop."""
+        with self._lock:
+            subs = list(self._partial_subs.get(fingerprint, ()))
+        for cb in subs:
+            try:
+                cb(doc)
+            except Exception:
+                self._count("partial_emit_failed")
+
+    def _run_progressive(self, request, program, machine, fingerprint,
+                         trace_id: str | None = None,
+                         span_id: str | None = None,
+                         meta: dict | None = None):
+        """The progressive-precision execution path (same return shape
+        as _run_chain): rounds of increasing sample prefixes with a
+        bootstrap confidence band between rounds, streaming one
+        `partial` doc per completed round to the subscribers.
+
+        Deadline handling is COOPERATIVE, not an engine downgrade:
+        when the request deadline expires at a round boundary, the
+        tightest band reached so far IS the answer — returned as a
+        `partial_final` record with a `precision:band=<w>@round=<r>`
+        degrade hop. The hop makes the result degraded, so the
+        existing cache guard keeps it out of the persistent cache;
+        converged runs (band under tolerance, or the full schedule —
+        which is bit-identical to the one-shot sampled run) return
+        undegraded and cache under the normal fingerprint."""
+        from ..sampler.sampled import run_sampled_progressive
+
+        deadline = (
+            None if request.deadline_s is None
+            else time.perf_counter() + request.deadline_s
+        )
+        v2 = request.runtime == "v2"
+
+        def should_stop() -> bool:
+            return (deadline is not None
+                    and time.perf_counter() >= deadline)
+
+        def on_round(info) -> None:
+            self._count("partials_emitted")
+            self._fire_partial(fingerprint, {
+                "partial": True,
+                "round": info["round"],
+                "rounds_total": info["rounds_total"],
+                "band_width": float(info["band_width"]),
+                "converged": bool(info["converged"]),
+                "mrc_digest": obs_ledger.mrc_digest(info["mrc"]),
+                "mrc_len": int(len(info["mrc"])),
+                "mrc_lines": report.mrc_lines(
+                    info["mrc"], header=False
+                ),
+            })
+
+        attrs = {"engine": "sampled", "program": program.name,
+                 "progressive": True}
+        if trace_id is not None:
+            attrs["trace_id"] = trace_id
+        if span_id is not None:
+            attrs["span_id"] = span_id
+        try:
+            with telemetry.span("service_exec", **attrs):
+                faults.fire("engine_execute", key=fingerprint,
+                            engine="sampled", model=program.name)
+                state, results, info = run_sampled_progressive(
+                    program, machine, sampler_config(request), v2=v2,
+                    on_round=on_round, should_stop=should_stop,
+                    fault_key=fingerprint,
+                )
+                record = build_record(
+                    request, machine, "sampled", fingerprint,
+                    _sampled_namespace(state, results), results,
+                )
+        except Exception as e:
+            return None, [], repr(e), None
+        degraded: list[dict] = []
+        prog = {
+            "rounds": info["rounds"],
+            "band_width": info["band_width"],
+            "converged": info["converged"],
+        }
+        if info["stopped"] == "deadline":
+            # NOT an engine downgrade: sampled answered, just at a
+            # looser precision than a full schedule would have
+            prog["partial_final"] = True
+            self._count("partial_final")
+            self._note_degrade(
+                degraded, fingerprint, "sampled", "sampled",
+                "precision:band={:.4g}@round={}".format(
+                    info["band_width"], info["rounds"],
+                ),
+            )
+        else:
+            self._count("progressive_converged")
+        if meta is not None:
+            meta["progressive"] = prog
+        return record, degraded, None, None
+
     def _run_chain(self, request, program, machine, fingerprint,
                    trace_id: str | None = None,
                    span_id: str | None = None,
@@ -1533,6 +1709,11 @@ class RequestExecutor:
         never trips the breaker: the abandoned thread may still be
         computing a perfectly good answer; only raised failures
         count."""
+        if progressive_requested(request):
+            return self._run_progressive(
+                request, program, machine, fingerprint,
+                trace_id=trace_id, span_id=span_id, meta=meta,
+            )
         chain = degrade_chain(request.engine)
         deadline = (
             None if request.deadline_s is None

@@ -87,12 +87,15 @@ class Entry:
     __slots__ = ("seq", "line", "line_no", "req_id", "fp", "owner",
                  "hops", "degrade", "doc", "trace_id", "span_id",
                  "meta", "t_created", "t_routed", "t_sent", "_event",
-                 "_callback", "_lock")
+                 "_callback", "_lock", "on_partial")
 
     def __init__(self, seq: int, line: str, line_no: int):
         self.seq = seq
         self.line = line
         self.line_no = line_no
+        # streamed progressive-precision round docs forward through
+        # this callback (set by the serving front before routing)
+        self.on_partial = None
         self.req_id: str | None = None
         self.fp: str | None = None
         self.owner: int | None = None
@@ -279,6 +282,8 @@ class WorkerLink:
             kind = frame.get("type")
             if kind == "response":
                 self.router._on_response(self, frame)
+            elif kind == "partial":
+                self.router._on_partial(self, frame)
             elif kind == "pong":
                 self._on_pong(frame)
             elif kind == "stats":
@@ -368,6 +373,12 @@ class WorkerLink:
         with self._lock:
             return self.inflight.pop(seq, None)
 
+    def peek(self, seq: int) -> Entry | None:
+        """Non-removing inflight lookup — partial frames observe the
+        entry without resolving it (the response frame still pops)."""
+        with self._lock:
+            return self.inflight.get(seq)
+
     def shutdown(self, timeout: float) -> bool:
         """Graceful: ask the worker to drain, wait for its bye."""
         conn = self._conn
@@ -430,6 +441,7 @@ class Router:
         self.counters = {
             "lines": 0, "routed": 0, "local": 0, "redispatched": 0,
             "responses": 0, "dropped_stale": 0, "no_worker": 0,
+            "partials_forwarded": 0, "partials_dropped_stale": 0,
             "tcp_clients": 0, "stats_polls": 0, "router_rows": 0,
             "ledger_write_failed": 0,
         }
@@ -523,12 +535,16 @@ class Router:
         except Exception:
             return content_digest({"line": line}), None
 
-    def submit_line(self, line: str, line_no: int = 0) -> Entry:
+    def submit_line(self, line: str, line_no: int = 0,
+                    on_partial=None) -> Entry:
         """Route one JSONL line; returns its Entry (resolving to the
-        serve-protocol response dict)."""
+        serve-protocol response dict). `on_partial` receives any
+        progressive-precision round docs the owning worker streams
+        ahead of the final response (already id-tagged)."""
         with self._lock:
             self._seq += 1
             entry = Entry(self._seq, line.strip(), line_no)
+        entry.on_partial = on_partial
         self.counters["lines"] += 1
         line = entry.line
         if len(line) > api.MAX_REQUEST_LINE_BYTES:
@@ -711,6 +727,27 @@ class Router:
             self.counters["router_rows"] += 1
         except Exception:
             self.counters["ledger_write_failed"] += 1
+
+    def _on_partial(self, link: WorkerLink, frame: dict) -> None:
+        """A streamed progressive-precision round from a worker:
+        forward to the seq's CURRENT owner's client, never resolve.
+        The same exactly-once ownership rule responses obey applies —
+        a zombie link's stream for a re-dispatched seq is dropped (the
+        new owner re-streams its own rounds)."""
+        seq = frame.get("seq")
+        doc = frame.get("doc")
+        entry = link.peek(seq) if isinstance(seq, int) else None
+        if (entry is None or entry.owner != link.worker_id
+                or not isinstance(doc, dict)):
+            self.counters["partials_dropped_stale"] += 1
+            return
+        self.counters["partials_forwarded"] += 1
+        cb = entry.on_partial
+        if cb is not None:
+            try:
+                cb(doc)
+            except Exception:
+                pass  # a client write failure never takes the link down
 
     def _on_link_dead(self, link: WorkerLink) -> None:
         """Reconnects exhausted: re-dispatch the dead worker's
@@ -965,11 +1002,22 @@ class Router:
         GracefulShutdown in either pass stops reading and answers
         everything already dispatched."""
         entries: list[Entry] = []
+        # partials stream from link reader threads while this thread
+        # is still reading/emitting: one lock per output stream
+        wlock = threading.Lock()
+
+        def _stream_partial(doc: dict) -> None:
+            with wlock:
+                fout.write(json.dumps(doc) + "\n")
+                fout.flush()
+
         try:
             for line_no, line in enumerate(fin, start=1):
                 if not line.strip():
                     continue
-                entries.append(self.submit_line(line, line_no))
+                entries.append(self.submit_line(
+                    line, line_no, on_partial=_stream_partial
+                ))
         except api.GracefulShutdown:
             self._draining = True
         failures = 0
@@ -991,8 +1039,9 @@ class Router:
                 doc = entry.doc
             if not doc.get("ok"):
                 failures += 1
-            fout.write(json.dumps(doc) + "\n")
-            fout.flush()
+            with wlock:
+                fout.write(json.dumps(doc) + "\n")
+                fout.flush()
         return failures
 
     def serve_tcp(self, host: str = "127.0.0.1", port: int = 0
@@ -1044,7 +1093,8 @@ class Router:
             for line_no, line in enumerate(rfile, start=1):
                 if not line.strip():
                     continue
-                entry = self.submit_line(line, line_no)
+                entry = self.submit_line(line, line_no,
+                                         on_partial=_emit)
                 pending.append(entry)
                 entry.on_done(_emit)
             for entry in pending:

@@ -27,6 +27,14 @@ Frame vocabulary (the `type` field):
               the same request. Trace context never enters the
               request payload or fingerprint — placement and tracing
               are both invisible to the MRC bytes.
+    partial   worker -> router: {"seq": N, "doc": <partial dict>}.
+              An interim progressive-precision result for the request
+              dispatched as `seq` — `doc` carries `partial: true`,
+              the request `id`, `round`/`rounds_total`, `band_width`,
+              and the interim MRC digest/lines. Zero or more partials
+              precede the request's single `response` frame; the
+              router forwards them immediately (never re-ordered,
+              never cached) to whichever client owns the seq.
     response  worker -> router: {"seq": N, "doc": <serve response
               dict>}. Out-of-order by design; the router re-orders by
               seq for file mode and matches by id for TCP clients.
@@ -61,9 +69,11 @@ import struct
 import threading
 
 # v2: optional `trace` blocks on request/response frames + the
-# `stats` frame type (fleet telemetry). The handshake still gates on
-# exact equality — both ends ship in this repo.
-WIRE_VERSION = 2
+# `stats` frame type (fleet telemetry).
+# v3: the `partial` frame type (streamed progressive-precision
+# interim results). The handshake still gates on exact equality —
+# both ends ship in this repo.
+WIRE_VERSION = 3
 
 # Frame payload cap: the serve protocol's 1 MiB request-line budget,
 # times 4 for the envelope's JSON re-escaping (every quote/backslash

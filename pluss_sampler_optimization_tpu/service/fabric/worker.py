@@ -42,7 +42,7 @@ from . import wire
 
 
 def handle_line(service, line: str, line_no: int = 0,
-                trace_id: str | None = None):
+                trace_id: str | None = None, on_partial=None):
     """serve_jsonl's per-line read-pass semantics for ONE line.
 
     Returns ("doc", response_dict) for lines answerable immediately
@@ -51,6 +51,11 @@ def handle_line(service, line: str, line_no: int = 0,
     and builds the response with `response_doc`. Mirrors
     api.serve_jsonl branch for branch so fabric-served lines produce
     identical structured responses.
+
+    `on_partial`, when given, receives each streamed progressive-
+    precision round doc (the request `id` stamped in, exactly as
+    serve_jsonl emits it) — only requests that actually ask for
+    progressive precision register a callback.
 
     `trace_id` is the router-propagated trace context: a parsed
     request that names no trace_id of its own ADOPTS it (so the worker
@@ -121,7 +126,13 @@ def handle_line(service, line: str, line_no: int = 0,
         request = api.parse_request_line(line)
         if trace_id and request.trace_id is None:
             request = dataclasses.replace(request, trace_id=trace_id)
-        ticket = service.submit(request)
+        cb = None
+        if on_partial is not None and api.progressive_requested(request):
+            def cb(doc, _rid=request.id):
+                msg = dict(doc)
+                msg["id"] = _rid
+                on_partial(msg)
+        ticket = service.submit(request, on_partial=cb)
         return ("ticket", ticket, request)
     except Exception as e:
         out = {"id": doc_id, "ok": False, "line": line_no,
@@ -258,6 +269,7 @@ class WorkerServer:
         self._lock = threading.Lock()
         self.stats_counters = {
             "connections": 0, "requests": 0, "responses": 0,
+            "partials": 0,
             "handshake_rejected": 0, "faults_disconnect": 0,
             "stats_polls": 0,
         }
@@ -448,8 +460,18 @@ class WorkerServer:
                 "error": f"fault injected: {e}",
             }, trace=_trace_out())
             return
+        def _partial(doc, conn=conn, seq=seq):
+            # best-effort stream: a partial lost to a dead link is
+            # simply gone (the final response is what the router
+            # re-dispatches for; partials are never replayed)
+            try:
+                conn.send({"type": "partial", "seq": seq, "doc": doc})
+                self.stats_counters["partials"] += 1
+            except (wire.WireError, OSError):
+                pass
+
         handled = handle_line(self.service, line, line_no,
-                              trace_id=trace_id)
+                              trace_id=trace_id, on_partial=_partial)
         if handled[0] == "doc":
             self._send_response(conn, seq, handled[1],
                                 trace=_trace_out())

@@ -620,6 +620,108 @@ def test_assemble_chrome_trace_golden():
         == "feedface00000001"
 
 
+# -- progressive partial streaming (ISSUE 20) --------------------------
+
+
+def _prog_line(i: int) -> str:
+    return json.dumps({
+        "id": f"pp-{i}", "model": "gemm", "n": 16, "engine": "sampled",
+        "ratio": 0.2, "seed": 7600 + i, "tolerance": 0.0,
+        "max_rounds": 3,
+    })
+
+
+def test_fabric_streams_partials_interleaved_and_failover():
+    """Progressive requests through a 2-worker fabric: every `partial`
+    frame forwards with the owning request's id, per id the round
+    indices arrive strictly in submission order 1..N even when the
+    requests interleave across workers, the final digest matches a
+    direct serve_jsonl run of the same line, and after a worker dies
+    (bounded reconnect -> re-dispatch) partials still stream with the
+    right id from the surviving worker."""
+    import threading
+
+    services = [
+        AnalysisService(cache_dir=None, max_workers=2, worker_id=i)
+        for i in range(2)
+    ]
+    workers = []
+    partials: list = []
+    plock = threading.Lock()
+
+    def on_partial(doc):
+        with plock:
+            partials.append(dict(doc))
+
+    try:
+        for i, svc in enumerate(services):
+            ws = WorkerServer(svc, worker_id=i, fabric=_CFG)
+            ws.start()
+            workers.append(ws)
+        router = Router([ws.address for ws in workers], _CFG)
+        router.start()
+        try:
+            lines = [_prog_line(i) for i in range(4)]
+            entries = [
+                router.submit_line(ln, no, on_partial=on_partial)
+                for no, ln in enumerate(lines, start=1)
+            ]
+            docs = [e.wait(timeout=TIMEOUT_S) for e in entries]
+            assert all(d is not None and d.get("ok") for d in docs)
+            assert all(d.get("converged") for d in docs)
+            assert router.counters["partials_dropped_stale"] == 0
+            assert router.counters["partials_forwarded"] == len(partials)
+
+            # per-id round order: every request streamed rounds 1..3
+            per: dict = {}
+            for p in partials:
+                assert p.get("partial") is True
+                per.setdefault(p["id"], []).append(p["round"])
+            assert set(per) == {f"pp-{i}" for i in range(4)}
+            for rounds in per.values():
+                assert rounds == [1, 2, 3]
+
+            # digest parity with a direct serve_jsonl run of pp-0
+            with AnalysisService(cache_dir=None) as solo_svc:
+                fout = io.StringIO()
+                serve_jsonl(solo_svc, io.StringIO(lines[0] + "\n"),
+                            fout)
+            solo_docs = [json.loads(ln)
+                         for ln in fout.getvalue().splitlines()]
+            solo_final = [d for d in solo_docs if not d.get("partial")]
+            fabric_final = {d["id"]: d for d in docs}["pp-0"]
+            assert solo_final[0]["mrc_digest"] \
+                == fabric_final["mrc_digest"]
+            assert solo_final[0]["fingerprint"] \
+                == fabric_final["fingerprint"]
+
+            # kill worker 0; the router's bounded reconnect fails and
+            # re-dispatches to the survivor — partial frames still
+            # stream under the new owner with the right id
+            workers[0].close()
+            with plock:
+                partials.clear()
+            line = json.dumps({
+                "id": "pp-f", "model": "gemm", "n": 16,
+                "engine": "sampled", "ratio": 0.2, "seed": 7650,
+                "tolerance": 0.0, "max_rounds": 3,
+            })
+            entry = router.submit_line(line, 99,
+                                       on_partial=on_partial)
+            doc = entry.wait(timeout=TIMEOUT_S)
+            assert doc is not None and doc.get("ok")
+            with plock:
+                got = [p for p in partials if p["id"] == "pp-f"]
+            assert [p["round"] for p in got] == [1, 2, 3]
+        finally:
+            router.close(graceful=True)
+    finally:
+        for ws in workers:
+            ws.close()
+        for svc in services:
+            svc.close()
+
+
 # -- the subprocess CI gate --------------------------------------------
 
 

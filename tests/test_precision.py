@@ -1,0 +1,297 @@
+"""Progressive precision (ISSUE 20): confidence-bounded adaptive
+sampling (sampler/sampled.py::run_sampled_progressive +
+sampler/confidence.py), streamed partial results, and
+deadline-graceful band degradation.
+
+The acceptance invariants pinned here:
+
+- PREFIX BIT-IDENTITY: a full-schedule progressive run folds the
+  exact one-shot sample set — MRC bytes, per-ref sample counts and
+  histograms identical to run_sampled at the same (ratio, seed) —
+  and through the service the converged response carries the same
+  fingerprint and digest as a plain sampled request (the progressive
+  knobs live OUTSIDE the fingerprint, like fuse_refs).
+- The bootstrap band is a pure function of (blocks, seed, round):
+  same inputs => bit-equal band, no clock, no entropy
+  (tools/lint_determinism.py lints the whole module).
+- Streamed bands never widen round over round; a generous tolerance
+  stops the schedule early and says so.
+- Band-aware drift verdicts: rows carrying `band_width` breach on
+  delta > band; band-less rows keep the global DRIFT_THRESHOLDS path
+  byte-for-byte (the ledger-migration contract).
+- Ledger schema v2 accepts the optional `rounds` / `band_width` /
+  `converged` request columns and rejects malformed values.
+- tools/check_precision.py (prefix identity, monotone bands,
+  deadline mid-round -> exactly one partial_final, exact replay)
+  passes from tier-1.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pluss_sampler_optimization_tpu import SamplerConfig
+from pluss_sampler_optimization_tpu.config import MachineConfig
+from pluss_sampler_optimization_tpu.models import build
+from pluss_sampler_optimization_tpu.runtime.aet import aet_mrc
+from pluss_sampler_optimization_tpu.runtime.cri import cri_distribute
+from pluss_sampler_optimization_tpu.runtime.obs import (
+    drift,
+    ledger as obs_ledger,
+)
+from pluss_sampler_optimization_tpu.sampler import confidence
+from pluss_sampler_optimization_tpu.sampler.sampled import (
+    run_sampled,
+    run_sampled_progressive,
+)
+from pluss_sampler_optimization_tpu.service import (
+    AnalysisRequest,
+    AnalysisService,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+import check_precision  # noqa: E402
+
+
+# -- schedules ---------------------------------------------------------
+
+
+def test_resolve_schedule_defaults_and_validation():
+    assert confidence.resolve_schedule(SamplerConfig()) \
+        == (0.125, 0.25, 0.5, 1.0)
+    assert confidence.resolve_schedule(
+        SamplerConfig(max_rounds=3)) == (0.25, 0.5, 1.0)
+    assert confidence.resolve_schedule(
+        SamplerConfig(max_rounds=1)) == (1.0,)
+    assert confidence.resolve_schedule(
+        SamplerConfig(round_schedule=(0.1, 1.0))) == (0.1, 1.0)
+    for bad in ((), (0.5, 0.5, 1.0), (0.5, 0.25, 1.0),
+                (0.0, 1.0), (0.25, 0.5)):
+        with pytest.raises(ValueError):
+            confidence.resolve_schedule(
+                SamplerConfig(round_schedule=bad))
+
+
+def test_round_counts_cumulative_and_final_pinned():
+    assert confidence.round_counts(10, (0.25, 0.5, 1.0)) == [3, 5, 10]
+    assert confidence.round_counts(1, (0.125, 0.25, 0.5, 1.0)) \
+        == [1, 1, 1, 1]
+    # final entry is ALWAYS the exact total — the bit-identity pin
+    assert confidence.round_counts(7, (0.3, 1.0))[-1] == 7
+    assert confidence.round_counts(0, (0.5, 1.0)) == [0, 0]
+
+
+def test_block_bounds_partition_exactly():
+    assert confidence.block_bounds(5, 5) == []
+    assert confidence.block_bounds(0, 2, blocks=4) == [(0, 1), (1, 2)]
+    bounds = confidence.block_bounds(3, 103, blocks=4)
+    assert bounds[0][0] == 3 and bounds[-1][1] == 103
+    assert all(a < b for a, b in bounds)
+    assert all(b0[1] == b1[0] for b0, b1 in zip(bounds, bounds[1:]))
+
+
+# -- bootstrap determinism --------------------------------------------
+
+
+def _toy_blocks():
+    return [
+        [({2: 5.0, 4: 1.0}, {}, 1), ({3: 2.0}, {}, 0),
+         ({1: 4.0}, {2: {5: 1.0}}, 2)],
+        [({7: 3.0}, {}, 0), ({2: 1.0, 9: 2.0}, {}, 1)],
+    ]
+
+
+def test_resample_weights_replay_and_shape():
+    blocks = _toy_blocks()
+    w1 = confidence._resample_weights(blocks, seed=11, round_idx=2,
+                                      replicate=3)
+    w2 = confidence._resample_weights(blocks, seed=11, round_idx=2,
+                                      replicate=3)
+    assert w1 == w2  # pure function of (blocks, seed, round, rep)
+    assert [len(m) for m in w1] == [3, 2]
+    assert [sum(m) for m in w1] == [3, 2]  # with-replacement, n draws
+    others = [
+        confidence._resample_weights(blocks, seed=11, round_idx=2,
+                                     replicate=r)
+        for r in range(8)
+    ]
+    assert any(w != w1 for w in others)  # replicates actually differ
+
+
+def test_bootstrap_band_deterministic_and_none_weight_exact():
+    machine = MachineConfig()
+    blocks = _toy_blocks()
+    b1 = confidence.bootstrap_band(blocks, machine, seed=5,
+                                   round_idx=1)
+    b2 = confidence.bootstrap_band(blocks, machine, seed=5,
+                                   round_idx=1)
+    assert b1 == b2 and np.isfinite(b1) and b1 >= 0.0
+    assert confidence.bootstrap_band([], machine, seed=5,
+                                     round_idx=0) == float("inf")
+    # weights=None folds the cumulative state exactly once per block
+    st = confidence.fold_blocks(blocks, machine.thread_num, False)
+    ones = [[1] * len(b) for b in blocks]
+    st2 = confidence.fold_blocks(blocks, machine.thread_num, False,
+                                 weights=ones)
+    m1 = confidence.mrc_from_state(st, machine)
+    m2 = confidence.mrc_from_state(st2, machine)
+    assert np.array_equal(m1, m2)
+
+
+# -- the engine: prefix bit-identity and early stop --------------------
+
+
+def test_progressive_full_schedule_bit_identical_to_one_shot():
+    program = build("gemm", 24)
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=3, max_rounds=3)
+    bands = []
+    state_p, results_p, info = run_sampled_progressive(
+        program, machine, cfg,
+        on_round=lambda i: bands.append(i["band_width"]),
+    )
+    state_o, results_o = run_sampled(program, machine, cfg)
+    T = machine.thread_num
+    mrc_p = aet_mrc(cri_distribute(state_p, T, T), machine)
+    mrc_o = aet_mrc(cri_distribute(state_o, T, T), machine)
+    assert np.array_equal(mrc_p, mrc_o)
+    for rp, ro in zip(results_p, results_o):
+        assert rp.n_samples == ro.n_samples
+        assert rp.noshare == ro.noshare and rp.share == ro.share
+    assert info["rounds"] == info["rounds_total"] == 3
+    assert info["converged"] and info["stopped"] in (None, "converged")
+    # streamed bands never widen
+    assert all(b <= a for a, b in zip(bands, bands[1:]))
+
+
+def test_progressive_generous_tolerance_stops_early():
+    program = build("gemm", 24)
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=3, max_rounds=4,
+                        tolerance=10.0)  # any band satisfies this
+    _state, _results, info = run_sampled_progressive(
+        program, machine, cfg,
+    )
+    assert info["converged"] and info["stopped"] == "converged"
+    assert info["rounds"] == 1 < info["rounds_total"]
+    assert info["band_width"] <= 10.0
+
+
+def test_progressive_should_stop_mid_schedule():
+    program = build("gemm", 24)
+    machine = MachineConfig()
+    cfg = SamplerConfig(ratio=0.3, seed=3, max_rounds=3,
+                        tolerance=0.0)
+    calls = []
+
+    def stop():
+        calls.append(1)
+        return len(calls) >= 2  # allow round 0, stop before round 2
+
+    _state, _results, info = run_sampled_progressive(
+        program, machine, cfg, should_stop=stop,
+    )
+    assert info["stopped"] == "deadline" and not info["converged"]
+    assert 1 <= info["rounds"] < info["rounds_total"]
+    assert np.isfinite(info["band_width"])
+
+
+# -- the service: out-of-fingerprint knobs, converged == one-shot ------
+
+
+def test_service_converged_response_matches_plain_sampled():
+    base = dict(model="gemm", n=16, engine="sampled", ratio=0.2,
+                seed=41)
+    with AnalysisService(cache_dir=None) as svc:
+        plain = svc.result(svc.submit(AnalysisRequest(**base)))
+    with AnalysisService(cache_dir=None) as svc:
+        prog = svc.result(svc.submit(AnalysisRequest(
+            **base, tolerance=0.0, max_rounds=3)))
+    assert plain.ok and prog.ok
+    # knobs are OUT of the fingerprint; the converged bytes match
+    assert prog.fingerprint == plain.fingerprint
+    assert prog.mrc_digest == plain.mrc_digest
+    assert prog.converged and not prog.partial_final
+    assert prog.rounds == 3 and prog.band_width is not None
+    assert plain.rounds is None and plain.band_width is None
+    assert not plain.degraded and not prog.degraded
+
+
+# -- drift: band-aware verdicts + migration contract -------------------
+
+
+def test_breach_verdict_band_aware_and_migration():
+    metrics = {"max_abs_delta": 0.2, "mean_abs_delta": 0.01}
+    # global path: 0.2 < 0.35 and 0.01 < 0.05 -> no breach
+    assert drift.breach_verdict(metrics) is False
+    # band-aware: delta beyond the band is a breach, inside is not
+    assert drift.breach_verdict(metrics, band_width=0.1) is True
+    assert drift.breach_verdict(metrics, band_width=0.3) is False
+    assert drift.breach_verdict(metrics, band_width=0.0) is True
+    # non-usable band values fall back to the global thresholds
+    for bogus in (None, True, False, float("inf"), float("nan"), -0.5):
+        assert drift.breach_verdict(metrics, band_width=bogus) is False
+    # row_breach: the ledger-migration contract — a band-less row
+    # (every row written before bands existed) re-evaluates on the
+    # global path byte-for-byte
+    old_row = dict(metrics)
+    assert drift.row_breach(old_row) == drift.breach_verdict(metrics)
+    banded = {**metrics, "band_width": 0.1}
+    assert drift.row_breach(banded) is True
+
+
+# -- ledger schema: optional progressive columns -----------------------
+
+
+def _req_row(**extra):
+    row = {
+        "ledger_version": 2, "ts": 1.0, "kind": "request",
+        "source": "test", "ok": True, "id": "r1",
+        "engine_requested": "sampled", "engine_used": "sampled",
+        "model": "gemm", "n": 16, "degraded": [],
+        "fingerprint": "f" * 16, "cache": "miss", "latency_s": 0.1,
+        "mrc_digest": "d" * 16,
+    }
+    row.update(extra)
+    return row
+
+
+def test_ledger_accepts_and_validates_progressive_columns(tmp_path):
+    ok_row = _req_row(rounds=3, band_width=0.02, converged=True)
+    assert obs_ledger.validate_row(ok_row) == []
+    assert obs_ledger.validate_row(
+        _req_row(rounds=None, band_width=None)) == []
+    assert obs_ledger.validate_row(_req_row()) == []  # columns optional
+    errs = obs_ledger.validate_row(
+        _req_row(rounds="three", band_width="wide", converged="yes"))
+    assert len(errs) == 3
+    # and a written row round-trips through the file
+    path = str(tmp_path / "ledger.jsonl")
+    obs_ledger.append(path, ok_row)
+    with open(path) as f:
+        back = json.loads(f.read().splitlines()[-1])
+    assert back["rounds"] == 3 and back["converged"] is True
+
+
+# -- the CI gate -------------------------------------------------------
+
+
+def test_check_precision_gate_engine_level():
+    """Prefix identity + monotone bands over 2 seeds, no service
+    spin-up (the deadline/replay half runs in the slow gate below)."""
+    assert check_precision.main(
+        ["--seeds", "0,1", "--models", "gemm", "--skip-deadline"]
+    ) == 0
+
+
+def test_check_precision_gate_deadline_and_replay():
+    """The full gate for one seed: deadline mid-round -> exactly one
+    partial_final with the last streamed band and a `precision:*`
+    degrade hop, never cached, and an exact replay."""
+    assert check_precision.main(
+        ["--seeds", "0", "--models", "gemm"]
+    ) == 0

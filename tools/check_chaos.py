@@ -24,6 +24,12 @@ the resilience layer exists to provide:
                  second replica; the winner's result is the result
   shedding       under pinned overload, admission control holds p95
                  while the shed-disabled baseline's p95 collapses
+  precision      a seeded round_exec hang mid-schedule makes a
+                 progressive-precision request's deadline expire
+                 between rounds: the service answers with exactly one
+                 partial_final (precision:* degrade hop, confidence
+                 band from the last completed round), and the whole
+                 outcome replays exactly from (seed, spec)
   fabric         a 3-worker serving fabric (service/fabric/) under a
                  worker_conn partition blip and a hard worker kill
                  mid-load: every submitted line reaches exactly one
@@ -596,6 +602,79 @@ def check_fabric_chaos(seed: int, tmp: str, problems: list) -> None:
         )
 
 
+def check_progressive_deadline(seed: int, problems: list) -> None:
+    """A seeded round_exec hang on round 1 (with a deadline sized to
+    cover round 0 but not the hang) forces the progressive engine to
+    stop at a round boundary: the request must resolve to exactly one
+    partial_final carrying a precision:* degrade hop and the last
+    streamed round's band, and a second armed run must reproduce the
+    identical (rounds, band, digest) tuple — the round count is a
+    pure function of (fault spec, deadline), never machine speed.
+
+    Uses a REAL AnalysisService (not the synthetic runner): the
+    progressive round loop IS the engine under test."""
+    from pluss_sampler_optimization_tpu.service import (
+        AnalysisService,
+        serve_jsonl,
+    )
+
+    line = json.dumps({
+        "id": "prog-dl", "model": loadgen.MODEL, "n": 32,
+        "engine": "sampled", "ratio": 0.3, "seed": 7000 + seed,
+        "tolerance": 0.0, "max_rounds": 3, "deadline_s": 1.0,
+    })
+
+    def run():
+        faults.install(FaultConfig(seed=seed, rules=(
+            {"site": "round_exec", "kind": "hang", "hang_s": 3.0,
+             "match": {"round": 1}, "p": 1.0, "max_fires": 1},
+        )))
+        try:
+            with AnalysisService(cache_dir=None) as svc:
+                fout = io.StringIO()
+                serve_jsonl(svc, io.StringIO(line + "\n"), fout)
+        finally:
+            faults.uninstall()
+        docs = [json.loads(ln)
+                for ln in fout.getvalue().splitlines()]
+        return ([d for d in docs if d.get("partial")],
+                [d for d in docs if not d.get("partial")])
+
+    partials, finals = run()
+    if len(finals) != 1 or not finals[0].get("partial_final"):
+        problems.append(
+            f"seed {seed}: progressive deadline did not yield exactly "
+            f"one partial_final ({len(finals)} finals, "
+            f"{finals[0] if finals else None})"
+        )
+        return
+    final = finals[0]
+    if not any(str(h.get("reason", "")).startswith("precision:")
+               for h in (final.get("degraded") or [])):
+        problems.append(
+            f"seed {seed}: partial_final lacks a precision:* degrade "
+            f"hop: {final.get('degraded')}"
+        )
+    if not partials or final.get("band_width") > \
+            partials[-1]["band_width"]:
+        problems.append(
+            f"seed {seed}: partial_final band "
+            f"{final.get('band_width')} exceeds the last streamed "
+            f"partial ({partials[-1]['band_width'] if partials else None})"
+        )
+    partials2, finals2 = run()
+    want = (final.get("rounds"), final.get("band_width"),
+            final.get("mrc_digest"), len(partials))
+    final2 = finals2[0] if finals2 else {}
+    got = (final2.get("rounds"), final2.get("band_width"),
+           final2.get("mrc_digest"), len(partials2))
+    if want != got:
+        problems.append(
+            f"seed {seed}: progressive deadline replay diverged: "
+            f"{want} != {got}"
+        )
+
+
 def check_overload(seed: int, problems: list, slow: bool) -> None:
     """The pinned overload pair: same arrivals, shed on vs off."""
     kw = dict(n=400, rate_rps=400.0, queue_limit=4, max_workers=2,
@@ -695,6 +774,7 @@ def run_seed(seed: int, slow: bool, witness: bool = False) -> list[str]:
         check_attempt_timeout(seed, problems)
         check_hedging(seed, problems)
         check_serve_line_faults(seed, problems)
+        check_progressive_deadline(seed, problems)
         check_fabric_chaos(seed, tmp, problems)
         check_overload(seed, problems, slow)
         if witness:

@@ -72,6 +72,11 @@ TARGETS = (
     # platform, library availability) — a clock or RNG here would
     # make bit-identity across kernel_backend values unreproducible
     (f"{PKG}/sampler/sampled.py", "_resolve_kernel_backend"),
+    # progressive precision: bootstrap resamples, round schedules, and
+    # band folds must replay exactly from the request (seed, knobs) —
+    # any clock/RNG here breaks partial_final replay and the
+    # tolerance-stop round count (tools/check_precision.py pins both)
+    (f"{PKG}/sampler/confidence.py", None),
 )
 
 ALLOWLIST_PATH = os.path.join(

@@ -26,7 +26,18 @@ service time becomes a knob instead of a measurement artifact.
     python tools/loadgen.py --requests 100 --rate 300 \
         --queue-limit 6 --service-time-s 0.03 [--no-shed] \
         [--mix low:0.2,normal:0.6,high:0.2] [--burst 0.1:0.2:3] \
+        [--tolerance-mix 0.05:0.5,none:0.5] \
+        [--deadline-mix 0.5:0.3,none:0.7] \
         [--fault-spec FILE] [--ledger PATH] [--json PATH]
+
+--tolerance-mix / --deadline-mix draw per-request progressive-
+precision knobs (tolerance / deadline_s; "none" = absent) from the
+same counter-hash stream, so a precision-mixed load run replays
+exactly. The report then carries a `precision` section — progressive
+requests split into converged vs partial_final vs shed, plus the
+partial-frame count per request — both in-process and over
+--connect (where the reader diverts streamed `"partial": true` docs
+into per-id counters instead of mistaking them for finals).
 
 With --connect HOST:PORT the same deterministic arrival sequence is
 driven over TCP against a live `serve --listen` or fabric
@@ -115,10 +126,39 @@ def parse_mix(spec: str) -> tuple:
     return tuple(out)
 
 
+def parse_value_mix(spec: str) -> tuple:
+    """"0.05:0.5,none:0.5" -> ((0.05, 0.5), (None, 0.5)): a weighted
+    mix of numeric knob values, "none" meaning the knob is absent."""
+    out = []
+    for part in spec.split(","):
+        val, _, w = part.partition(":")
+        val = val.strip().lower()
+        v = None if val in ("none", "off", "-") else float(val)
+        out.append((v, float(w) if w else 1.0))
+    if not out or sum(w for _, w in out) <= 0:
+        raise ValueError(f"empty/zero-weight value mix {spec!r}")
+    return tuple(out)
+
+
+def _draw_mix(mix: tuple, seed: int, tag: str, i: int):
+    """One weighted draw from a ((value, weight), ...) mix, keyed
+    (seed, tag, i) on the counter-hash stream — replays exactly."""
+    total = sum(w for _, w in mix)
+    u = faults.counter_u01(seed, tag, i) * total
+    acc = 0.0
+    for v, w in mix:
+        acc += w
+        if u < acc:
+            return v
+    return mix[-1][0]
+
+
 def make_requests(n: int, seed: int,
                   mix: tuple = (("normal", 1.0),),
                   unique_frac: float = 1.0,
-                  hot_set: int = 4) -> list:
+                  hot_set: int = 4,
+                  tolerance_mix: tuple | None = None,
+                  deadline_mix: tuple | None = None) -> list:
     """n AnalysisRequests, deterministic from (seed, mix, unique_frac).
 
     A request is "unique" (fresh fingerprint — forced cache miss and
@@ -129,6 +169,10 @@ def make_requests(n: int, seed: int,
     (the record pipeline folds the memoized engine state per the
     request's machine config) — a cross-wired response under chaos
     shows up as a digest mismatch, not a silent coincidence.
+
+    `tolerance_mix` / `deadline_mix` (parse_value_mix shapes) draw a
+    per-request tolerance / deadline_s from the same stream — a
+    drawn tolerance makes the request progressive-precision.
     """
     from pluss_sampler_optimization_tpu.service import AnalysisRequest
 
@@ -149,10 +193,14 @@ def make_requests(n: int, seed: int,
             rseed = int(
                 faults.counter_u01(seed, "hot", i) * max(1, hot_set)
             )
+        tol = (_draw_mix(tolerance_mix, seed, "tol", i)
+               if tolerance_mix else None)
+        ddl = (_draw_mix(deadline_mix, seed, "ddl", i)
+               if deadline_mix else None)
         reqs.append(AnalysisRequest(
             model=MODEL, n=MODEL_N, engine="sampled", ratio=0.2,
             seed=rseed, threads=2 + (rseed % 3), priority=prio,
-            id=f"lg-{i}",
+            id=f"lg-{i}", tolerance=tol, deadline_s=ddl,
         ))
     return reqs
 
@@ -211,13 +259,25 @@ def run_load(service, requests: list, offsets: list[float],
     loop); a submit that sheds resolves its future immediately, so
     overload costs the client microseconds, not a queue slot.
     """
+    from pluss_sampler_optimization_tpu.service.executor import (
+        progressive_requested,
+    )
+
     t0 = time.perf_counter()
+    prog_ids = {r.id for r in requests if progressive_requested(r)}
+    partial_counts: dict = {}
+    plock = threading.Lock()
     tickets = []
     for req, off in zip(requests, offsets):
         now = time.perf_counter() - t0
         if off > now:
             time.sleep(off - now)
-        tickets.append(service.submit(req))
+
+        def _on_partial(doc, _rid=req.id):
+            with plock:
+                partial_counts[_rid] = partial_counts.get(_rid, 0) + 1
+
+        tickets.append(service.submit(req, on_partial=_on_partial))
     resps = [service.result(t, timeout=timeout_s) for t in tickets]
     wall = time.perf_counter() - t0
 
@@ -243,8 +303,36 @@ def run_load(service, requests: list, offsets: list[float],
             round(obs_ledger._percentile(lats, q), 6) if lats
             else None
         )
+    report["precision"] = _precision_section(
+        [dataclasses.asdict(r) for r in resps], partial_counts,
+        prog_ids,
+    )
     report["responses"] = resps  # stripped before JSON/ledger output
     return report
+
+
+def _precision_section(docs: list, partial_counts: dict,
+                       prog_ids: set) -> dict:
+    """The progressive-precision rollup of one load run: how many
+    requests asked for progressive sampling, of those how many
+    converged vs hit a deadline partial_final vs were shed before
+    running, and how many partial frames streamed per progressive
+    request."""
+    prog = [d for d in docs if d.get("id") in prog_ids]
+    ran = [d for d in prog if d.get("rounds") is not None]
+    frames = sum(partial_counts.values())
+    return {
+        "progressive": len(prog),
+        "converged": sum(1 for d in ran if d.get("converged")),
+        "partial_final": sum(
+            1 for d in ran if d.get("partial_final")
+        ),
+        "shed": sum(1 for d in prog if d.get("shed")),
+        "partial_frames": frames,
+        "partials_per_request": (
+            round(frames / len(ran), 2) if ran else None
+        ),
+    }
 
 
 def request_jsonl(req) -> str:
@@ -277,11 +365,16 @@ def connect_run(addr: str, requests: list, offsets: list[float],
     added on top of engine execution (None against servers that
     predate the execute_s response field).
     """
+    from pluss_sampler_optimization_tpu.service.executor import (
+        progressive_requested,
+    )
     from pluss_sampler_optimization_tpu.service.fabric import wire
 
     host, port = wire.parse_hostport(addr)
     want = {r.id for r in requests}
+    prog_ids = {r.id for r in requests if progressive_requested(r)}
     docs: dict = {}
+    partial_counts: dict = {}
     sent: dict = {}
     recv: dict = {}
     done = threading.Event()
@@ -300,6 +393,14 @@ def connect_run(addr: str, requests: list, offsets: list[float],
                 except ValueError:
                     continue
                 if isinstance(doc, dict) and doc.get("id") in want:
+                    if doc.get("partial"):
+                        # an interim progressive frame, never the
+                        # final response — count it, keep waiting
+                        rid = doc["id"]
+                        partial_counts[rid] = (
+                            partial_counts.get(rid, 0) + 1
+                        )
+                        continue
                     recv[doc["id"]] = time.perf_counter()
                     docs[doc["id"]] = doc
                     if len(docs) == len(want):
@@ -372,6 +473,9 @@ def connect_run(addr: str, requests: list, offsets: list[float],
             round(obs_ledger._percentile(overheads, q), 6)
             if overheads else None
         )
+    report["precision"] = _precision_section(
+        got, partial_counts, prog_ids
+    )
     return report
 
 
@@ -386,7 +490,9 @@ def overload_run(shed_enabled: bool, n: int = 100,
                  burst: tuple | None = None,
                  cache_dir: str | None = None,
                  ledger_path: str | None = None,
-                 timeout_s: float = 120.0) -> dict:
+                 timeout_s: float = 120.0,
+                 tolerance_mix: tuple | None = None,
+                 deadline_mix: tuple | None = None) -> dict:
     """One pinned overload experiment: offered load ~rate_rps against
     a service whose capacity is max_workers / service_time_s, with
     the admission gate on or off. Returns the run_load report plus
@@ -399,7 +505,9 @@ def overload_run(shed_enabled: bool, n: int = 100,
     res = ResilienceConfig(
         queue_limit=queue_limit, shed_enabled=shed_enabled
     )
-    reqs = make_requests(n, seed, mix=mix)
+    reqs = make_requests(n, seed, mix=mix,
+                         tolerance_mix=tolerance_mix,
+                         deadline_mix=deadline_mix)
     offs = arrival_offsets(n, rate_rps, seed, burst=burst)
     with AnalysisService(
         max_workers=max_workers, cache_dir=cache_dir,
@@ -485,6 +593,14 @@ def main(argv=None) -> int:
                     "fingerprints (rest hit a small hot set)")
     ap.add_argument("--burst", default=None,
                     help="start:duration:multiplier rate burst")
+    ap.add_argument("--tolerance-mix", default=None,
+                    help="progressive tolerance mix, e.g. "
+                    "0.05:0.5,none:0.5 (value:weight pairs; 'none' "
+                    "keeps a request one-shot)")
+    ap.add_argument("--deadline-mix", default=None,
+                    help="deadline_s mix, e.g. 0.5:0.3,none:0.7 — "
+                    "with --tolerance-mix this exercises the "
+                    "partial_final degrade path")
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="drive a live serve/serve-router TCP "
                     "listener instead of an in-process service "
@@ -504,6 +620,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     mix = parse_mix(args.mix)
+    tol_mix = (parse_value_mix(args.tolerance_mix)
+               if args.tolerance_mix else None)
+    ddl_mix = (parse_value_mix(args.deadline_mix)
+               if args.deadline_mix else None)
     burst = _parse_burst(args.burst) if args.burst else None
     injector = None
     if args.fault_spec:
@@ -518,7 +638,9 @@ def main(argv=None) -> int:
     try:
         if args.connect:
             reqs = make_requests(args.requests, args.seed, mix=mix,
-                                 unique_frac=args.unique_frac)
+                                 unique_frac=args.unique_frac,
+                                 tolerance_mix=tol_mix,
+                                 deadline_mix=ddl_mix)
             offs = arrival_offsets(args.requests, args.rate,
                                    args.seed, burst=burst)
             report = connect_run(args.connect, reqs, offs,
@@ -540,6 +662,7 @@ def main(argv=None) -> int:
                 max_workers=args.max_workers,
                 service_time_s=args.service_time_s, seed=args.seed,
                 mix=mix, burst=burst, timeout_s=args.timeout_s,
+                tolerance_mix=tol_mix, deadline_mix=ddl_mix,
             ))
             headline = report
     finally:
